@@ -1,0 +1,145 @@
+"""Operator registry: schemas, shape inference, lowering rules, grad makers.
+
+This is the TPU-native replacement for the reference's static-init op registry
+(reference: paddle/fluid/framework/op_registry.h:68-243 REGISTER_OPERATOR /
+REGISTER_OP_*_KERNEL macros and op_proto_maker.h attribute schemas). Instead of
+per-device kernel maps, each op registers ONE ``lower`` rule that emits jax ops
+while a whole program block is traced to a single XLA executable — the ngraph
+subgraph-bridge strategy (reference: paddle/fluid/operators/ngraph/) applied to
+the entire block.
+
+Gradients: the reference attaches a C++ GradOpDescMaker per op
+(grad_op_desc_maker.h:36). Here the default grad maker is *generic*: it emits a
+``<type>_grad`` op whose lowering recomputes the forward rule under ``jax.vjp``.
+XLA CSEs the duplicated forward subexpression, so there is no runtime cost, and
+we get 500-op autodiff coverage without 500 hand-written grad kernels. Ops with
+special semantics can register a custom grad maker or custom grad lowering.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+_OP_REGISTRY: Dict[str, "OpDef"] = {}
+
+
+@dataclasses.dataclass
+class IOSpec:
+    """One input/output slot of an op (reference OpProto::Var)."""
+
+    name: str
+    duplicable: bool = False  # slot may hold a list of vars (e.g. sum's X)
+    optional: bool = False    # slot may be absent
+    no_grad: bool = False     # never produce/needs no gradient for this slot
+
+
+@dataclasses.dataclass
+class AttrSpec:
+    name: str
+    default: Any = None
+    required: bool = False
+
+
+@dataclasses.dataclass
+class OpDef:
+    """Schema + behaviour of one operator type."""
+
+    type: str
+    inputs: List[IOSpec] = dataclasses.field(default_factory=list)
+    outputs: List[IOSpec] = dataclasses.field(default_factory=list)
+    attrs: Dict[str, AttrSpec] = dataclasses.field(default_factory=dict)
+    # infer_shape(op, block): set shapes/dtypes on output vars at build time.
+    infer_shape: Optional[Callable] = None
+    # lower(ctx, ins, attrs) -> {out_slot: [jax_array, ...]}
+    lower: Optional[Callable] = None
+    # 'auto' -> generic vjp grad; None -> non-differentiable; callable -> custom
+    # maker(op, block, no_grad_set) -> list of op-dicts for the backward block.
+    grad: Any = "auto"
+    # If set, custom lowering for the auto '<type>_grad' op.
+    grad_lower: Optional[Callable] = None
+    # stateful ops (random) receive a PRNG key in ctx
+    needs_rng: bool = False
+    # slots of the *forward* op that the auto-grad lowering does not need
+    # (lets the executor drop dead buffers, cf. NoNeedBufferVarsInference)
+    no_need_buffer: Sequence[str] = ()
+
+    def input_spec(self, slot: str) -> Optional[IOSpec]:
+        for s in self.inputs:
+            if s.name == slot:
+                return s
+        return None
+
+    def output_spec(self, slot: str) -> Optional[IOSpec]:
+        for s in self.outputs:
+            if s.name == slot:
+                return s
+        return None
+
+
+def register_op(
+    type: str,
+    inputs: Sequence = (),
+    outputs: Sequence = (),
+    attrs: Optional[Dict[str, Any]] = None,
+    infer_shape: Optional[Callable] = None,
+    grad: Any = "auto",
+    grad_lower: Optional[Callable] = None,
+    needs_rng: bool = False,
+    no_need_buffer: Sequence[str] = (),
+):
+    """Decorator registering ``fn`` as the lowering rule for op ``type``.
+
+    ``inputs``/``outputs`` entries are either slot-name strings or IOSpec.
+    ``attrs`` maps attr name -> default value (or AttrSpec).
+    """
+
+    def norm_io(items) -> List[IOSpec]:
+        out = []
+        for it in items:
+            if isinstance(it, IOSpec):
+                out.append(it)
+            else:
+                out.append(IOSpec(name=it))
+        return out
+
+    def norm_attrs(a) -> Dict[str, AttrSpec]:
+        result = {}
+        for k, v in (a or {}).items():
+            result[k] = v if isinstance(v, AttrSpec) else AttrSpec(name=k, default=v)
+        return result
+
+    def deco(fn: Callable) -> Callable:
+        if type in _OP_REGISTRY:
+            raise ValueError(f"op '{type}' registered twice")
+        _OP_REGISTRY[type] = OpDef(
+            type=type,
+            inputs=norm_io(inputs),
+            outputs=norm_io(outputs),
+            attrs=norm_attrs(attrs),
+            infer_shape=infer_shape,
+            lower=fn,
+            grad=grad,
+            grad_lower=grad_lower,
+            needs_rng=needs_rng,
+            no_need_buffer=tuple(no_need_buffer),
+        )
+        return fn
+
+    return deco
+
+
+def get_op_def(type: str) -> OpDef:
+    if type not in _OP_REGISTRY:
+        raise KeyError(
+            f"operator '{type}' is not registered; known ops: "
+            f"{sorted(_OP_REGISTRY)[:20]}... ({len(_OP_REGISTRY)} total)"
+        )
+    return _OP_REGISTRY[type]
+
+
+def has_op(type: str) -> bool:
+    return type in _OP_REGISTRY
+
+
+def all_ops() -> List[str]:
+    return sorted(_OP_REGISTRY)

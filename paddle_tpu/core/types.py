@@ -1,0 +1,84 @@
+"""Type system for the TPU-native framework.
+
+Plays the role of the reference's ``framework.proto`` VarType/DataType enums
+(reference: paddle/fluid/framework/framework.proto:105-160) but maps directly
+onto numpy/jax dtypes instead of a protobuf enum.
+"""
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class VarType(enum.Enum):
+    """Variable kinds (reference framework.proto:105 ``VarType.Type``)."""
+
+    LOD_TENSOR = "lod_tensor"
+    SELECTED_ROWS = "selected_rows"
+    LOD_TENSOR_ARRAY = "lod_tensor_array"
+    STEP_SCOPES = "step_scopes"
+    READER = "reader"
+    RAW = "raw"
+
+
+# Canonical dtype strings. We use numpy-style names everywhere; bf16 is
+# first-class because it is the native TPU matmul type.
+_CANONICAL = {
+    "float32": "float32",
+    "float64": "float64",
+    "float16": "float16",
+    "bfloat16": "bfloat16",
+    "int8": "int8",
+    "uint8": "uint8",
+    "int16": "int16",
+    "int32": "int32",
+    "int64": "int64",
+    "bool": "bool",
+    # aliases
+    "fp32": "float32",
+    "fp64": "float64",
+    "fp16": "float16",
+    "bf16": "bfloat16",
+    "float": "float32",
+    "double": "float64",
+    "int": "int32",
+    "long": "int64",
+}
+
+
+def canonical_dtype(dtype) -> str:
+    """Normalise a user-provided dtype (str / np.dtype / jnp dtype) to a
+    canonical string name."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        name = dtype.lower()
+        if name in _CANONICAL:
+            return _CANONICAL[name]
+        raise ValueError(f"unknown dtype string: {dtype!r}")
+    # handle jax / numpy dtype-like objects (incl. ml_dtypes.bfloat16)
+    name = np.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    if name in _CANONICAL:
+        return _CANONICAL[name]
+    name = str(dtype)
+    if name in _CANONICAL:
+        return _CANONICAL[name]
+    raise ValueError(f"unknown dtype: {dtype!r}")
+
+
+def np_dtype(dtype) -> np.dtype:
+    name = canonical_dtype(dtype)
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def is_floating(dtype) -> bool:
+    return canonical_dtype(dtype) in ("float16", "float32", "float64", "bfloat16")
+
+
+def is_integer(dtype) -> bool:
+    return canonical_dtype(dtype) in ("int8", "uint8", "int16", "int32", "int64")

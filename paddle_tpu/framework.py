@@ -1,0 +1,570 @@
+"""Program IR: Program / Block / Operator / Variable / Parameter.
+
+TPU-native re-design of the reference's graph-program layer
+(reference: python/paddle/fluid/framework.py:408 Variable, :1320 Operator,
+:1769 Block, :3152 Program, :4095 Parameter and the C++ descs behind them,
+paddle/fluid/framework/framework.proto:43-220). Differences by design:
+
+* One representation, not desc+wrapper twins: the Python objects ARE the IR,
+  with a JSON-serialisable dict form replacing protobuf (``Program.to_dict``).
+* No per-op kernels behind the ops — an entire block lowers to one XLA
+  executable (see ``paddle_tpu.lowering``); ops here are pure metadata.
+* Every op gets a stable ``__uid__`` attr at append time. Random ops derive
+  their PRNG key from it, and the auto-generated ``*_grad`` op reuses the
+  forward uid so grad-side recomputation sees identical randomness.
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import unique_name
+from .core import registry
+from .core.types import VarType, canonical_dtype
+
+__all__ = [
+    "Program",
+    "Block",
+    "Operator",
+    "Variable",
+    "Parameter",
+    "default_main_program",
+    "default_startup_program",
+    "program_guard",
+    "name_scope",
+    "grad_var_name",
+    "in_dygraph_mode",
+]
+
+GRAD_VAR_SUFFIX = "@GRAD"
+ZERO_VAR_SUFFIX = "@ZERO"
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_VAR_SUFFIX
+
+
+class Variable:
+    """A named tensor in a Block (reference framework.py:408).
+
+    Build-time metadata only; runtime values live in the executor Scope as jax
+    arrays. ``shape`` may contain -1 for dims resolved at feed time.
+    """
+
+    def __init__(
+        self,
+        block: "Block",
+        name: str,
+        shape: Optional[Sequence[int]] = None,
+        dtype: Any = "float32",
+        type: VarType = VarType.LOD_TENSOR,
+        lod_level: int = 0,
+        persistable: bool = False,
+        stop_gradient: bool = False,
+        is_data: bool = False,
+        initializer=None,
+        **kwargs,
+    ):
+        self.block = block
+        self.name = name
+        self.shape = tuple(int(s) for s in shape) if shape is not None else None
+        self.dtype = canonical_dtype(dtype)
+        self.type = type
+        self.lod_level = lod_level
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        # distributed annotation: optional PartitionSpec-like tuple mapping
+        # each dim to a mesh axis name (or None). Consumed by parallel/.
+        self.dist_spec: Optional[tuple] = None
+
+    # -- convenience -----------------------------------------------------
+    @property
+    def grad_name(self) -> str:
+        return grad_var_name(self.name)
+
+    def astype(self, dtype):
+        from .layers import tensor as _t
+
+        return _t.cast(self, dtype)
+
+    def __repr__(self):
+        return (
+            f"Variable(name={self.name!r}, shape={self.shape}, dtype={self.dtype},"
+            f" persistable={self.persistable}, stop_gradient={self.stop_gradient})"
+        )
+
+    # arithmetic sugar (reference: math_op_patch.py monkeypatch)
+    def _binary(self, other, op, reverse=False):
+        from .layers import math_op_patch
+
+        return math_op_patch.binary(self, other, op, reverse)
+
+    def __add__(self, o):
+        return self._binary(o, "elementwise_add")
+
+    def __radd__(self, o):
+        return self._binary(o, "elementwise_add", True)
+
+    def __sub__(self, o):
+        return self._binary(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elementwise_sub", True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elementwise_mul")
+
+    def __rmul__(self, o):
+        return self._binary(o, "elementwise_mul", True)
+
+    def __truediv__(self, o):
+        return self._binary(o, "elementwise_div")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "elementwise_div", True)
+
+    def __pow__(self, o):
+        return self._binary(o, "elementwise_pow")
+
+    def __neg__(self):
+        from .layers import tensor as _t
+
+        return _t.scale(self, scale=-1.0)
+
+    def __matmul__(self, o):
+        from .layers import nn as _nn
+
+        return _nn.matmul(self, o)
+
+    # -- serialization ---------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "shape": list(self.shape) if self.shape is not None else None,
+            "dtype": self.dtype,
+            "type": self.type.value,
+            "lod_level": self.lod_level,
+            "persistable": self.persistable,
+            "stop_gradient": self.stop_gradient,
+            "is_data": self.is_data,
+        }
+
+    @staticmethod
+    def from_dict(block: "Block", d: dict) -> "Variable":
+        return Variable(
+            block,
+            name=d["name"],
+            shape=d["shape"],
+            dtype=d["dtype"],
+            type=VarType(d["type"]),
+            lod_level=d.get("lod_level", 0),
+            persistable=d.get("persistable", False),
+            stop_gradient=d.get("stop_gradient", False),
+            is_data=d.get("is_data", False),
+        )
+
+
+class Parameter(Variable):
+    """A trainable persistable Variable (reference framework.py:4095)."""
+
+    def __init__(self, block, name, shape, dtype, **kwargs):
+        kwargs.setdefault("persistable", True)
+        self.trainable = kwargs.pop("trainable", True)
+        self.optimize_attr = kwargs.pop("optimize_attr", {"learning_rate": 1.0})
+        self.regularizer = kwargs.pop("regularizer", None)
+        self.do_model_average = kwargs.pop("do_model_average", None)
+        super().__init__(block, name, shape=shape, dtype=dtype, **kwargs)
+
+    def to_dict(self):
+        d = super().to_dict()
+        d["is_parameter"] = True
+        d["trainable"] = self.trainable
+        d["optimize_attr"] = self.optimize_attr
+        return d
+
+
+class Operator:
+    """One op in a Block (reference framework.py:1320 + C++ OpDesc).
+
+    inputs/outputs map slot name -> list of var *names*; attrs is a plain
+    dict checked against the registered OpDef schema.
+    """
+
+    def __init__(
+        self,
+        block: "Block",
+        type: str,
+        inputs: Optional[Dict[str, Any]] = None,
+        outputs: Optional[Dict[str, Any]] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ):
+        self.block = block
+        self.type = type
+        self.inputs: Dict[str, List[str]] = {}
+        self.outputs: Dict[str, List[str]] = {}
+        for slot, vars_ in (inputs or {}).items():
+            self.inputs[slot] = [v.name if isinstance(v, Variable) else v for v in _as_list(vars_)]
+        for slot, vars_ in (outputs or {}).items():
+            self.outputs[slot] = [v.name if isinstance(v, Variable) else v for v in _as_list(vars_)]
+        self.attrs: Dict[str, Any] = dict(attrs or {})
+
+        # fill attr defaults from schema when the op is registered
+        if registry.has_op(type):
+            opdef = registry.get_op_def(type)
+            for aname, aspec in opdef.attrs.items():
+                if aname not in self.attrs:
+                    if aspec.required:
+                        raise ValueError(f"op {type}: required attr '{aname}' missing")
+                    self.attrs[aname] = copy.copy(aspec.default)
+        elif not (type.endswith("_grad") and registry.has_op(type[:-5])) \
+                and type not in ("feed", "fetch"):
+            raise ValueError(
+                f"operator '{type}' is not registered "
+                f"({len(registry.all_ops())} ops known)")
+
+    def input(self, slot: str) -> List[str]:
+        return self.inputs.get(slot, [])
+
+    def output(self, slot: str) -> List[str]:
+        return self.outputs.get(slot, [])
+
+    @property
+    def input_arg_names(self) -> List[str]:
+        return [n for ns in self.inputs.values() for n in ns]
+
+    @property
+    def output_arg_names(self) -> List[str]:
+        return [n for ns in self.outputs.values() for n in ns]
+
+    def attr(self, name: str):
+        return self.attrs.get(name)
+
+    def infer_shape(self):
+        if registry.has_op(self.type):
+            opdef = registry.get_op_def(self.type)
+            if opdef.infer_shape is not None:
+                opdef.infer_shape(self, self.block)
+            elif opdef.lower is not None:
+                from . import lowering
+
+                lowering.auto_infer_shape(self, self.block)
+
+    def __repr__(self):
+        ins = {k: v for k, v in self.inputs.items()}
+        outs = {k: v for k, v in self.outputs.items()}
+        return f"Op({self.type}, in={ins}, out={outs})"
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "inputs": {k: list(v) for k, v in self.inputs.items()},
+            "outputs": {k: list(v) for k, v in self.outputs.items()},
+            "attrs": _jsonable_attrs(self.attrs),
+        }
+
+    @staticmethod
+    def from_dict(block: "Block", d: dict) -> "Operator":
+        return Operator(
+            block, d["type"], inputs=d["inputs"], outputs=d["outputs"], attrs=d["attrs"]
+        )
+
+
+def _jsonable_attrs(attrs: dict) -> dict:
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, np.ndarray):
+            out[k] = {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+        elif isinstance(v, (np.integer,)):
+            out[k] = int(v)
+        elif isinstance(v, (np.floating,)):
+            out[k] = float(v)
+        else:
+            out[k] = v
+    return out
+
+
+class Block:
+    """An ordered list of ops plus a var table (reference framework.py:1769)."""
+
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.forward_block_idx = -1
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[Operator] = []
+
+    @property
+    def parent_block(self) -> Optional["Block"]:
+        if self.parent_idx < 0:
+            return None
+        return self.program.blocks[self.parent_idx]
+
+    # -- var management --------------------------------------------------
+    def create_var(self, name: Optional[str] = None, **kwargs) -> Variable:
+        if name is None:
+            name = unique_name.generate("_generated_var")
+        if name in self.vars:
+            return self.vars[name]
+        v = Variable(self, name, **kwargs)
+        self.vars[name] = v
+        return v
+
+    def create_parameter(self, name, shape, dtype, **kwargs) -> Parameter:
+        p = Parameter(self, name, shape, dtype, **kwargs)
+        self.vars[name] = p
+        return p
+
+    def var(self, name: str) -> Variable:
+        v = self.vars.get(name)
+        if v is None:
+            raise KeyError(f"variable '{name}' not found in block {self.idx}")
+        return v
+
+    def has_var(self, name: str) -> bool:
+        return name in self.vars
+
+    def _var_recursive(self, name: str) -> Variable:
+        """Find var in this or any ancestor block (reference Block.var climb)."""
+        blk = self
+        while blk is not None:
+            if name in blk.vars:
+                return blk.vars[name]
+            blk = blk.parent_block
+        raise KeyError(f"variable '{name}' not found in block {self.idx} or ancestors")
+
+    def has_var_recursive(self, name: str) -> bool:
+        try:
+            self._var_recursive(name)
+            return True
+        except KeyError:
+            return False
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # -- op management ---------------------------------------------------
+    def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
+        op.attrs.setdefault("__uid__", self.program._next_uid())
+        self.ops.append(op)
+        op.infer_shape()
+        return op
+
+    def prepend_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
+        op.attrs.setdefault("__uid__", self.program._next_uid())
+        self.ops.insert(0, op)
+        op.infer_shape()
+        return op
+
+    def insert_op(self, index: int, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
+        op.attrs.setdefault("__uid__", self.program._next_uid())
+        self.ops.insert(index, op)
+        op.infer_shape()
+        return op
+
+    # -- serialization ---------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "idx": self.idx,
+            "parent_idx": self.parent_idx,
+            "forward_block_idx": self.forward_block_idx,
+            "vars": [v.to_dict() for v in self.vars.values()],
+            "ops": [o.to_dict() for o in self.ops],
+        }
+
+
+class Program:
+    """A multi-block program (reference framework.py:3152, framework.proto:212)."""
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0)]
+        self.current_block_idx = 0
+        self._uid_counter = 0
+        self._seed = 0
+        # name -> lr-scheduler / misc program-level state
+        self._lr_schedulers = []
+        self.random_seed = 0
+
+    def _next_uid(self) -> int:
+        self._uid_counter += 1
+        return self._uid_counter
+
+    # -- blocks ----------------------------------------------------------
+    @property
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[self.current_block_idx]
+
+    def _create_block(self, parent_idx: Optional[int] = None) -> Block:
+        new_idx = len(self.blocks)
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        b = Block(self, new_idx, parent)
+        self.blocks.append(b)
+        self.current_block_idx = new_idx
+        return b
+
+    def _rollback(self):
+        self.current_block_idx = self.blocks[self.current_block_idx].parent_idx
+
+    # -- whole-program transforms ---------------------------------------
+    def clone(self, for_test: bool = False) -> "Program":
+        """Deep-copy (reference Program.clone framework.py:3376). With
+        ``for_test`` True, ops switch to inference behaviour via their
+        ``is_test`` attr (dropout/batch_norm)."""
+        p = Program.from_dict(self.to_dict())
+        p._uid_counter = self._uid_counter
+        p.random_seed = self.random_seed
+        if for_test:
+            for blk in p.blocks:
+                for op in blk.ops:
+                    if "is_test" in op.attrs:
+                        op.attrs["is_test"] = True
+                    if op.type == "batch_norm":
+                        op.attrs["use_global_stats"] = True
+        return p
+
+    def list_vars(self):
+        for blk in self.blocks:
+            yield from blk.vars.values()
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.list_vars() if isinstance(v, Parameter)]
+
+    # -- serialization ---------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "blocks": [b.to_dict() for b in self.blocks],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Program":
+        p = Program()
+        p.blocks = []
+        for bd in d["blocks"]:
+            b = Block(p, bd["idx"], bd["parent_idx"])
+            b.forward_block_idx = bd.get("forward_block_idx", -1)
+            p.blocks.append(b)
+        for b, bd in zip(p.blocks, d["blocks"]):
+            for vd in bd["vars"]:
+                if vd.get("is_parameter"):
+                    param = Parameter(
+                        b,
+                        vd["name"],
+                        vd["shape"],
+                        vd["dtype"],
+                        trainable=vd.get("trainable", True),
+                    )
+                    param.stop_gradient = vd.get("stop_gradient", False)
+                    b.vars[vd["name"]] = param
+                else:
+                    b.vars[vd["name"]] = Variable.from_dict(b, vd)
+            for od in bd["ops"]:
+                op = Operator.from_dict(b, od)
+                b.ops.append(op)
+                p._uid_counter = max(p._uid_counter, op.attrs.get("__uid__", 0))
+        return p
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @staticmethod
+    def from_json(s: str) -> "Program":
+        return Program.from_dict(json.loads(s))
+
+    def __repr__(self):
+        lines = []
+        for blk in self.blocks:
+            lines.append(f"-- block {blk.idx} (parent {blk.parent_idx}) --")
+            for v in blk.vars.values():
+                lines.append(f"  {v!r}")
+            for op in blk.ops:
+                lines.append(f"  {op!r}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# default program globals (reference framework.py:4190-4304)
+# ---------------------------------------------------------------------------
+
+_main_program = Program()
+_startup_program = Program()
+_dygraph_tracer = None  # set by dygraph.guard
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def switch_main_program(p: Program) -> Program:
+    global _main_program
+    old, _main_program = _main_program, p
+    return old
+
+
+def switch_startup_program(p: Program) -> Program:
+    global _startup_program
+    old, _startup_program = _startup_program, p
+    return old
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    old_main = switch_main_program(main_program)
+    old_startup = None
+    if startup_program is not None:
+        old_startup = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(old_main)
+        if old_startup is not None:
+            switch_startup_program(old_startup)
+
+
+_name_scope_stack: List[str] = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    _name_scope_stack.append(prefix)
+    try:
+        yield
+    finally:
+        _name_scope_stack.pop()
+
+
+def in_dygraph_mode() -> bool:
+    return _dygraph_tracer is not None
+
+
+def _current_tracer():
+    return _dygraph_tracer
+
+
+def _switch_tracer(tracer):
+    global _dygraph_tracer
+    old, _dygraph_tracer = _dygraph_tracer, tracer
+    return old
+
+
+def _as_list(x) -> list:
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]

@@ -1,0 +1,159 @@
+"""Initializers: emit init ops into the startup program.
+
+Reference: python/paddle/fluid/initializer.py:76-862 (Constant/Uniform/Normal/
+TruncatedNormal/Xavier/MSRA/Bilinear/NumpyArray). Same design: an initializer
+appends ONE op to the var's (startup) block; the Executor runs the startup
+program once and the resulting arrays become scope state.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["Constant", "Uniform", "Normal", "TruncatedNormal", "Xavier",
+           "MSRA", "Bilinear", "NumpyArrayInitializer", "ConstantInitializer",
+           "UniformInitializer", "NormalInitializer", "XavierInitializer",
+           "MSRAInitializer"]
+
+
+class Initializer:
+    def __call__(self, var, block):
+        raise NotImplementedError
+
+    @staticmethod
+    def _fan_in_out(var):
+        shape = var.shape
+        if len(shape) < 2:
+            return (shape[0], shape[0]) if shape else (1, 1)
+        receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+        fan_in = shape[1] * receptive if len(shape) > 2 else shape[0]
+        fan_out = shape[0] * receptive if len(shape) > 2 else shape[1]
+        return fan_in, fan_out
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, var, block):
+        return block.append_op(
+            "fill_constant", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "value": float(self.value)})
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0, seed: int = 0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            "uniform_random", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "min": self.low, "max": self.high, "seed": self.seed})
+
+
+class Normal(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0, seed: int = 0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            "gaussian_random", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "mean": self.loc, "std": self.scale, "seed": self.seed})
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0, seed: int = 0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            "truncated_gaussian_random", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "mean": self.loc, "std": self.scale, "seed": self.seed})
+
+
+class Xavier(Initializer):
+    """Glorot. uniform=True -> U(-sqrt(6/(fi+fo)), ...); else N(0, sqrt(2/(fi+fo)))."""
+
+    def __init__(self, uniform: bool = True, fan_in=None, fan_out=None, seed: int = 0):
+        self.uniform, self.fan_in, self.fan_out, self.seed = uniform, fan_in, fan_out, seed
+
+    def __call__(self, var, block):
+        fi, fo = self._fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            return Uniform(-limit, limit, self.seed)(var, block)
+        std = math.sqrt(2.0 / (fi + fo))
+        return Normal(0.0, std, self.seed)(var, block)
+
+
+class MSRA(Initializer):
+    """Kaiming He init."""
+
+    def __init__(self, uniform: bool = True, fan_in=None, seed: int = 0):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, var, block):
+        fi, _ = self._fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            return Uniform(-limit, limit, self.seed)(var, block)
+        std = math.sqrt(2.0 / fi)
+        return Normal(0.0, std, self.seed)(var, block)
+
+
+class Bilinear(Initializer):
+    """For upsample deconv weights (reference initializer.py:668)."""
+
+    def __call__(self, var, block):
+        shape = var.shape
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer requires 4-D weights")
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        weight = np.zeros(shape, dtype=np.float32)
+        size = shape[2] * shape[3]
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            w = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            idx = np.unravel_index(i, shape)
+            weight[idx] = w if idx[0] == idx[1] else weight[idx]
+        weight_flat = weight.reshape(-1)
+        return block.append_op(
+            "assign_value", outputs={"Out": var},
+            attrs={"shape": list(shape), "dtype": var.dtype,
+                   "values": [float(v) for v in weight_flat]})
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value: np.ndarray):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block):
+        return block.append_op(
+            "assign_value", outputs={"Out": var},
+            attrs={"shape": list(self.value.shape), "dtype": var.dtype,
+                   "values": [float(v) for v in self.value.astype(np.float64).flat]
+                   if self.value.dtype.kind == "f"
+                   else [int(v) for v in self.value.flat]})
+
+
+# reference-compatible aliases
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = Xavier
+MSRAInitializer = MSRA
+BilinearInitializer = Bilinear
+
+_global_weight_initializer = None
+_global_bias_initializer = None

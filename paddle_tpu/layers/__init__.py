@@ -1,0 +1,10 @@
+"""fluid.layers-compatible namespace."""
+from . import math_op_patch  # noqa: F401
+from .io import data  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .tensor import (assign, create_global_var, create_tensor,  # noqa: F401
+                     fill_constant, fill_constant_batch_size_like,
+                     gaussian_random, linspace, ones, ones_like,
+                     uniform_random, zeros, zeros_like)
+from . import nn  # noqa: F401
+from . import tensor  # noqa: F401

@@ -1,0 +1,24 @@
+"""Data layers (reference: python/paddle/fluid/layers/io.py `data`)."""
+from __future__ import annotations
+
+from ..core.types import canonical_dtype
+from ..framework import default_main_program
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         stop_gradient=True, type=None):
+    """Declare an input variable. With append_batch_size (reference default),
+    a -1 batch dim is prepended; shapes with explicit -1 are taken as-is."""
+    shape = list(shape)
+    if append_batch_size:
+        if any(s == -1 for s in shape):
+            append_batch_size = False
+        else:
+            shape = [-1] + shape
+    block = default_main_program().current_block()
+    v = block.create_var(name=name, shape=shape,
+                         dtype=canonical_dtype(dtype), lod_level=lod_level,
+                         stop_gradient=stop_gradient, is_data=True)
+    return v

@@ -1,0 +1,23 @@
+"""Operator-overload sugar on Variable (reference: layers/math_op_patch.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def binary(var, other, op_type: str, reverse: bool = False):
+    from ..framework import Variable
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper(op_type)
+    if not isinstance(other, Variable):
+        # scalar -> fill_constant of var's dtype, broadcastable shape [1]
+        val = float(other)
+        tmp = helper.create_variable_for_type_inference(dtype=var.dtype)
+        helper.append_op("fill_constant", outputs={"Out": tmp},
+                         attrs={"shape": [1], "dtype": var.dtype, "value": val})
+        other = tmp
+    x, y = (other, var) if reverse else (var, other)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(op_type, inputs={"X": x, "Y": y}, outputs={"Out": out},
+                     attrs={"axis": -1})
+    return out

@@ -1,0 +1,8 @@
+"""Operator library: importing this package registers all lowering rules."""
+from . import math  # noqa: F401
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+
+from ..core.registry import all_ops, get_op_def, has_op, register_op  # noqa: F401

@@ -1,0 +1,189 @@
+"""Loss + metric + reduce ops.
+
+References: paddle/fluid/operators/{softmax_with_cross_entropy,cross_entropy,
+mean,reduce_ops/*,metrics/*,smooth_l1_loss,huber_loss,sigmoid_cross_entropy...}
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import IOSpec, out, register_op, x
+
+
+@register_op("mean", inputs=["X"], outputs=["Out"])
+def _mean(ctx, ins, attrs):
+    return out(jnp.mean(x(ins)))
+
+
+def _reduce(fn):
+    def lower(ctx, ins, attrs):
+        xv = x(ins)
+        if attrs.get("reduce_all"):
+            axes = None
+        else:
+            axes = tuple(a if a >= 0 else a + xv.ndim for a in attrs.get("dim", [0]))
+        return out(fn(xv, axis=axes, keepdims=attrs.get("keep_dim", False)))
+
+    return lower
+
+
+for _name, _fn in [
+    ("reduce_sum", jnp.sum),
+    ("reduce_mean", jnp.mean),
+    ("reduce_max", jnp.max),
+    ("reduce_min", jnp.min),
+    ("reduce_prod", jnp.prod),
+]:
+    register_op(_name, inputs=["X"], outputs=["Out"],
+                attrs={"dim": [0], "keep_dim": False, "reduce_all": False})(_reduce(_fn))
+
+register_op("reduce_all", inputs=["X"], outputs=["Out"],
+            attrs={"dim": [0], "keep_dim": False, "reduce_all": False},
+            grad=None)(_reduce(jnp.all))
+register_op("reduce_any", inputs=["X"], outputs=["Out"],
+            attrs={"dim": [0], "keep_dim": False, "reduce_all": False},
+            grad=None)(_reduce(jnp.any))
+
+
+@register_op("softmax_with_cross_entropy",
+             inputs=[IOSpec("Logits"), IOSpec("Label", no_grad=True)],
+             outputs=["Softmax", "Loss"],
+             attrs={"soft_label": False, "ignore_index": -100, "axis": -1,
+                    "numeric_stable_mode": True})
+def _softmax_with_cross_entropy(ctx, ins, attrs):
+    logits, label = x(ins, "Logits"), x(ins, "Label")
+    axis = attrs.get("axis", -1)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    softmax = jnp.exp(logp)
+    if attrs.get("soft_label"):
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            pass
+        else:
+            lbl = jnp.expand_dims(lbl, axis)
+        lbl = lbl.astype(jnp.int32)
+        ig = attrs.get("ignore_index", -100)
+        ignored = lbl == ig
+        safe_lbl = jnp.where(ignored, 0, lbl)  # avoid OOB wrap on gather
+        picked = jnp.take_along_axis(logp, safe_lbl, axis=axis)
+        loss = jnp.where(ignored, 0.0, -picked)
+    return {"Softmax": [softmax], "Loss": [loss]}
+
+
+@register_op("cross_entropy",
+             inputs=[IOSpec("X"), IOSpec("Label", no_grad=True)],
+             outputs=["Y"],
+             attrs={"soft_label": False, "ignore_index": -100})
+def _cross_entropy(ctx, ins, attrs):
+    xv, label = x(ins, "X"), x(ins, "Label")
+    eps = 1e-12
+    if attrs.get("soft_label"):
+        y = -jnp.sum(label * jnp.log(xv + eps), axis=-1, keepdims=True)
+    else:
+        lbl = label if label.ndim == xv.ndim else jnp.expand_dims(label, -1)
+        picked = jnp.take_along_axis(xv, lbl.astype(jnp.int32), axis=-1)
+        y = -jnp.log(picked + eps)
+    return {"Y": [y]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits",
+             inputs=[IOSpec("X"), IOSpec("Label", no_grad=True)],
+             outputs=["Out"], attrs={"ignore_index": -100, "normalize": False})
+def _sigmoid_ce(ctx, ins, attrs):
+    xv, lbl = x(ins, "X"), x(ins, "Label")
+    loss = jnp.maximum(xv, 0) - xv * lbl + jnp.log1p(jnp.exp(-jnp.abs(xv)))
+    return out(loss)
+
+
+@register_op("square_error_cost", inputs=["X", "Y"], outputs=["Out"])
+def _square_error_cost(ctx, ins, attrs):
+    return out(jnp.square(x(ins, "X") - x(ins, "Y")))
+
+
+@register_op("huber_loss", inputs=[IOSpec("X"), IOSpec("Y", no_grad=True)],
+             outputs=["Out", "Residual"], attrs={"delta": 1.0})
+def _huber_loss(ctx, ins, attrs):
+    xv, yv = x(ins, "X"), x(ins, "Y")
+    d = attrs["delta"]
+    r = yv - xv
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= d, 0.5 * r * r, d * (ar - 0.5 * d))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register_op("smooth_l1_loss",
+             inputs=[IOSpec("X"), IOSpec("Y", no_grad=True),
+                     IOSpec("InsideWeight", optional=True, no_grad=True),
+                     IOSpec("OutsideWeight", optional=True, no_grad=True)],
+             outputs=["Out", "Diff"], attrs={"sigma": 1.0})
+def _smooth_l1(ctx, ins, attrs):
+    xv, yv = x(ins, "X"), x(ins, "Y")
+    iw, ow = x(ins, "InsideWeight"), x(ins, "OutsideWeight")
+    sigma2 = attrs["sigma"] ** 2
+    diff = xv - yv
+    if iw is not None:
+        diff = diff * iw
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad < 1.0 / sigma2, 0.5 * sigma2 * diff * diff,
+                     ad - 0.5 / sigma2)
+    if ow is not None:
+        loss = loss * ow
+    loss = jnp.sum(loss.reshape(xv.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [loss], "Diff": [diff]}
+
+
+@register_op("log_loss", inputs=[IOSpec("Predicted"), IOSpec("Labels", no_grad=True)],
+             outputs=["Loss"], attrs={"epsilon": 1e-4})
+def _log_loss(ctx, ins, attrs):
+    p, l = x(ins, "Predicted"), x(ins, "Labels")
+    eps = attrs["epsilon"]
+    return {"Loss": [-l * jnp.log(p + eps) - (1 - l) * jnp.log(1 - p + eps)]}
+
+
+@register_op("accuracy",
+             inputs=[IOSpec("Out", no_grad=True), IOSpec("Indices", no_grad=True),
+                     IOSpec("Label", no_grad=True)],
+             outputs=["Accuracy", "Correct", "Total"], grad=None)
+def _accuracy(ctx, ins, attrs):
+    """Reference metrics/accuracy_op: Indices is the top-k index matrix."""
+    idx, label = x(ins, "Indices"), x(ins, "Label")
+    lbl = label.reshape((-1, 1)).astype(idx.dtype)
+    correct_k = jnp.any(idx == lbl, axis=1)
+    num_correct = jnp.sum(correct_k.astype(jnp.float32))
+    total = jnp.asarray(idx.shape[0], jnp.int32)
+    return {"Accuracy": [(num_correct / idx.shape[0]).reshape((1,))],
+            "Correct": [num_correct.astype(jnp.int32).reshape((1,))],
+            "Total": [total.reshape((1,))]}
+
+
+@register_op("auc",
+             inputs=[IOSpec("Predict", no_grad=True), IOSpec("Label", no_grad=True),
+                     IOSpec("StatPos", no_grad=True), IOSpec("StatNeg", no_grad=True)],
+             outputs=["AUC", "StatPosOut", "StatNegOut"],
+             attrs={"curve": "ROC", "num_thresholds": 4095}, grad=None)
+def _auc(ctx, ins, attrs):
+    pred, label = x(ins, "Predict"), x(ins, "Label")
+    pos_stat, neg_stat = x(ins, "StatPos"), x(ins, "StatNeg")
+    nt = attrs["num_thresholds"]
+    p1 = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 else pred.reshape(-1)
+    lbl = label.reshape(-1).astype(jnp.float32)
+    bins = jnp.clip((p1 * nt).astype(jnp.int32), 0, nt)
+    pos_add = jnp.zeros((nt + 1,), jnp.int64).at[bins].add(lbl.astype(jnp.int64))
+    neg_add = jnp.zeros((nt + 1,), jnp.int64).at[bins].add((1 - lbl).astype(jnp.int64))
+    pos = pos_stat.reshape(-1) + pos_add
+    neg = neg_stat.reshape(-1) + neg_add
+    # trapezoid over thresholds descending
+    tp = jnp.cumsum(pos[::-1])
+    fp = jnp.cumsum(neg[::-1])
+    tot_pos, tot_neg = tp[-1], fp[-1]
+    tpr = tp / jnp.maximum(tot_pos, 1)
+    fpr = fp / jnp.maximum(tot_neg, 1)
+    auc = jnp.trapezoid(tpr, fpr)
+    return {"AUC": [auc.reshape((1,)).astype(jnp.float64)
+                    if auc.dtype == jnp.float64 else auc.reshape((1,))],
+            "StatPosOut": [pos.reshape(pos_stat.shape)],
+            "StatNegOut": [neg.reshape(neg_stat.shape)]}

@@ -1,0 +1,199 @@
+"""Elementwise, activation, scale/sum/cast ops.
+
+TPU-native equivalents of reference op families:
+* activations — paddle/fluid/operators/activation_op.{cc,cu}
+* elementwise — paddle/fluid/operators/elementwise/ (broadcast rule from
+  elementwise_op_function.h: Y spans X's dims starting at attr ``axis``)
+* scale/sum/cast/clip — paddle/fluid/operators/{scale,sum,cast,clip}_op.*
+
+Each is a pure jnp expression; XLA fuses chains of these into surrounding
+matmuls, which is why there is no hand-written "fused_elemwise_activation"
+here (reference operators/fused/) — the compiler does it.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.types import np_dtype
+from .common import IOSpec, broadcast_to_x, out, register_op, unary, x
+
+# -- activations ------------------------------------------------------------
+unary("relu", jax.nn.relu)
+unary("sigmoid", jax.nn.sigmoid)
+unary("tanh", jnp.tanh)
+unary("exp", jnp.exp)
+unary("log", jnp.log)
+unary("square", jnp.square)
+unary("sqrt", jnp.sqrt)
+unary("rsqrt", jax.lax.rsqrt)
+unary("abs", jnp.abs)
+unary("ceil", jnp.ceil, grad=None)
+unary("floor", jnp.floor, grad=None)
+unary("round", jnp.round, grad=None)
+unary("reciprocal", lambda v: 1.0 / v)
+unary("softplus", jax.nn.softplus)
+unary("softsign", jax.nn.soft_sign)
+unary("sin", jnp.sin)
+unary("cos", jnp.cos)
+unary("logsigmoid", jax.nn.log_sigmoid)
+unary("erf", jax.scipy.special.erf)
+
+
+@register_op("gelu", inputs=["X"], outputs=["Out"], attrs={"approximate": False})
+def _gelu(ctx, ins, attrs):
+    return out(jax.nn.gelu(x(ins), approximate=bool(attrs.get("approximate", False))))
+
+
+@register_op("leaky_relu", inputs=["X"], outputs=["Out"], attrs={"alpha": 0.02})
+def _leaky_relu(ctx, ins, attrs):
+    return out(jax.nn.leaky_relu(x(ins), negative_slope=attrs["alpha"]))
+
+
+@register_op("relu6", inputs=["X"], outputs=["Out"], attrs={"threshold": 6.0})
+def _relu6(ctx, ins, attrs):
+    return out(jnp.clip(x(ins), 0.0, attrs["threshold"]))
+
+
+@register_op("elu", inputs=["X"], outputs=["Out"], attrs={"alpha": 1.0})
+def _elu(ctx, ins, attrs):
+    return out(jax.nn.elu(x(ins), alpha=attrs["alpha"]))
+
+
+@register_op("hard_sigmoid", inputs=["X"], outputs=["Out"], attrs={"slope": 0.2, "offset": 0.5})
+def _hard_sigmoid(ctx, ins, attrs):
+    return out(jnp.clip(attrs["slope"] * x(ins) + attrs["offset"], 0.0, 1.0))
+
+
+@register_op("swish", inputs=["X"], outputs=["Out"], attrs={"beta": 1.0})
+def _swish(ctx, ins, attrs):
+    v = x(ins)
+    return out(v * jax.nn.sigmoid(attrs["beta"] * v))
+
+
+@register_op("hard_swish", inputs=["X"], outputs=["Out"],
+             attrs={"threshold": 6.0, "scale": 6.0, "offset": 3.0})
+def _hard_swish(ctx, ins, attrs):
+    v = x(ins)
+    return out(v * jnp.clip(v + attrs["offset"], 0, attrs["threshold"]) / attrs["scale"])
+
+
+@register_op("pow", inputs=["X"], outputs=["Out"], attrs={"factor": 1.0})
+def _pow(ctx, ins, attrs):
+    return out(jnp.power(x(ins), attrs["factor"]))
+
+
+@register_op("softmax", inputs=["X"], outputs=["Out"], attrs={"axis": -1})
+def _softmax(ctx, ins, attrs):
+    return out(jax.nn.softmax(x(ins), axis=attrs.get("axis", -1)))
+
+
+@register_op("log_softmax", inputs=["X"], outputs=["Out"], attrs={"axis": -1})
+def _log_softmax(ctx, ins, attrs):
+    return out(jax.nn.log_softmax(x(ins), axis=attrs.get("axis", -1)))
+
+
+# -- elementwise binary -----------------------------------------------------
+
+def _ew(fn):
+    def lower(ctx, ins, attrs):
+        xv, yv = x(ins, "X"), x(ins, "Y")
+        yv = broadcast_to_x(xv, yv, attrs.get("axis", -1))
+        return out(fn(xv, yv))
+
+    return lower
+
+
+for _name, _fn in [
+    ("elementwise_add", jnp.add),
+    ("elementwise_sub", jnp.subtract),
+    ("elementwise_mul", jnp.multiply),
+    ("elementwise_div", jnp.divide),
+    ("elementwise_min", jnp.minimum),
+    ("elementwise_max", jnp.maximum),
+    ("elementwise_pow", jnp.power),
+    ("elementwise_mod", jnp.mod),
+    ("elementwise_floordiv", jnp.floor_divide),
+]:
+    register_op(_name, inputs=["X", "Y"], outputs=["Out"], attrs={"axis": -1})(_ew(_fn))
+
+
+# -- scale / sum / cast / clip ---------------------------------------------
+
+@register_op("scale", inputs=["X"], outputs=["Out"],
+             attrs={"scale": 1.0, "bias": 0.0, "bias_after_scale": True})
+def _scale(ctx, ins, attrs):
+    v = x(ins)
+    if attrs.get("bias_after_scale", True):
+        return out(v * attrs["scale"] + attrs["bias"])
+    return out((v + attrs["bias"]) * attrs["scale"])
+
+
+@register_op("sum", inputs=[IOSpec("X", duplicable=True)], outputs=["Out"])
+def _sum(ctx, ins, attrs):
+    vals = [v for v in ins.get("X", []) if v is not None]
+    acc = vals[0]
+    for v in vals[1:]:
+        acc = acc + v
+    return out(acc)
+
+
+@register_op("cast", inputs=["X"], outputs=["Out"],
+             attrs={"in_dtype": None, "out_dtype": "float32"})
+def _cast(ctx, ins, attrs):
+    return out(x(ins).astype(np_dtype(attrs["out_dtype"])))
+
+
+@register_op("clip", inputs=["X"], outputs=["Out"], attrs={"min": -1.0, "max": 1.0})
+def _clip(ctx, ins, attrs):
+    return out(jnp.clip(x(ins), attrs["min"], attrs["max"]))
+
+
+@register_op("clip_by_norm", inputs=["X"], outputs=["Out"], attrs={"max_norm": 1.0})
+def _clip_by_norm(ctx, ins, attrs):
+    v = x(ins)
+    norm = jnp.sqrt(jnp.sum(jnp.square(v)))
+    scale = jnp.minimum(attrs["max_norm"] / jnp.maximum(norm, 1e-12), 1.0)
+    return out(v * scale)
+
+
+@register_op("squared_l2_norm", inputs=["X"], outputs=["Out"])
+def _squared_l2_norm(ctx, ins, attrs):
+    return out(jnp.sum(jnp.square(x(ins))).reshape((1,)))
+
+
+# -- comparison / logical (non-differentiable) ------------------------------
+
+for _name, _fn in [
+    ("equal", jnp.equal),
+    ("not_equal", jnp.not_equal),
+    ("less_than", jnp.less),
+    ("less_equal", jnp.less_equal),
+    ("greater_than", jnp.greater),
+    ("greater_equal", jnp.greater_equal),
+]:
+    def _cmp_lower(ctx, ins, attrs, _fn=_fn):
+        return out(_fn(x(ins, "X"), x(ins, "Y")))
+
+    register_op(_name, inputs=["X", "Y"], outputs=["Out"], grad=None)(_cmp_lower)
+
+
+for _name, _fn in [
+    ("logical_and", jnp.logical_and),
+    ("logical_or", jnp.logical_or),
+    ("logical_xor", jnp.logical_xor),
+]:
+    def _logical_lower(ctx, ins, attrs, _fn=_fn):
+        return out(_fn(x(ins, "X"), x(ins, "Y")))
+
+    register_op(_name, inputs=["X", "Y"], outputs=["Out"], grad=None)(_logical_lower)
+
+
+@register_op("logical_not", inputs=["X"], outputs=["Out"], grad=None)
+def _logical_not(ctx, ins, attrs):
+    return out(jnp.logical_not(x(ins)))
+
+
+@register_op("isfinite", inputs=["X"], outputs=["Out"], grad=None)
+def _isfinite(ctx, ins, attrs):
+    return out(jnp.all(jnp.isfinite(x(ins))).reshape((1,)))

@@ -1,0 +1,216 @@
+"""Optimizer update ops.
+
+Reference: paddle/fluid/operators/optimizers/ (13 update rules, each a CUDA
+kernel). Here each is a pure jnp update; the whole train step (forward +
+backward + all updates) compiles into ONE XLA executable, so the per-param
+"fused optimizer" passes of the reference (ir/fuse_optimizer_ops_pass/) are
+unnecessary — XLA fuses across params in the same program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .common import IOSpec, register_op, x
+
+
+@register_op("sgd", inputs=["Param", "Grad", "LearningRate"],
+             outputs=["ParamOut"], grad=None)
+def _sgd(ctx, ins, attrs):
+    p, g, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "LearningRate")
+    return {"ParamOut": [p - lr.reshape(()).astype(p.dtype) * g.astype(p.dtype)]}
+
+
+@register_op("momentum", inputs=["Param", "Grad", "Velocity", "LearningRate"],
+             outputs=["ParamOut", "VelocityOut"],
+             attrs={"mu": 0.9, "use_nesterov": False,
+                    "regularization_method": "", "regularization_coeff": 0.0},
+             grad=None)
+def _momentum(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad").astype(x(ins, "Param").dtype)
+    v, lr = x(ins, "Velocity"), x(ins, "LearningRate").reshape(())
+    mu = attrs["mu"]
+    if attrs.get("regularization_method") == "l2_decay":
+        g = g + attrs["regularization_coeff"] * p
+    v_out = mu * v + g
+    if attrs.get("use_nesterov"):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register_op("lars_momentum", inputs=["Param", "Grad", "Velocity", "LearningRate"],
+             outputs=["ParamOut", "VelocityOut"],
+             attrs={"mu": 0.9, "lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                    "epsilon": 0.0},
+             grad=None)
+def _lars_momentum(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    v, lr = x(ins, "Velocity"), x(ins, "LearningRate").reshape(())
+    mu, lars, wd = attrs["mu"], attrs["lars_coeff"], attrs["lars_weight_decay"]
+    pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (pn > 0) & (gn > 0),
+        lr * lars * pn / (gn + wd * pn + attrs.get("epsilon", 0.0)),
+        lr,
+    )
+    v_out = mu * v + local_lr * (g + wd * p)
+    return {"ParamOut": [p - v_out], "VelocityOut": [v_out]}
+
+
+@register_op("adam",
+             inputs=["Param", "Grad", "LearningRate", "Moment1", "Moment2",
+                     "Beta1Pow", "Beta2Pow"],
+             outputs=["ParamOut", "Moment1Out", "Moment2Out",
+                      "Beta1PowOut", "Beta2PowOut"],
+             attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+                    "lazy_mode": False},
+             grad=None)
+def _adam(ctx, ins, attrs):
+    p = x(ins, "Param")
+    g = x(ins, "Grad").astype(p.dtype)
+    lr = x(ins, "LearningRate").reshape(())
+    m1, m2 = x(ins, "Moment1"), x(ins, "Moment2")
+    b1p, b2p = x(ins, "Beta1Pow").reshape(()), x(ins, "Beta2Pow").reshape(())
+    b1, b2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
+    m1_out = b1 * m1 + (1 - b1) * g
+    m2_out = b2 * m2 + (1 - b2) * g * g
+    # bias correction uses the CURRENT pow accumulators (initialised to beta
+    # at step 1), matching reference adam_op.h; pows advance afterwards
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+    return {"ParamOut": [p_out], "Moment1Out": [m1_out], "Moment2Out": [m2_out],
+            "Beta1PowOut": [(b1p * b1).reshape((1,))],
+            "Beta2PowOut": [(b2p * b2).reshape((1,))]}
+
+
+@register_op("adamw",
+             inputs=["Param", "Grad", "LearningRate", "Moment1", "Moment2",
+                     "Beta1Pow", "Beta2Pow"],
+             outputs=["ParamOut", "Moment1Out", "Moment2Out",
+                      "Beta1PowOut", "Beta2PowOut"],
+             attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+                    "weight_decay": 0.01},
+             grad=None)
+def _adamw(ctx, ins, attrs):
+    p = x(ins, "Param")
+    lr = x(ins, "LearningRate").reshape(())
+    res = _adam(ctx, ins, attrs)
+    res["ParamOut"] = [res["ParamOut"][0] - lr * attrs["weight_decay"] * p]
+    return res
+
+
+@register_op("adagrad", inputs=["Param", "Grad", "Moment", "LearningRate"],
+             outputs=["ParamOut", "MomentOut"], attrs={"epsilon": 1e-6},
+             grad=None)
+def _adagrad(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    m, lr = x(ins, "Moment"), x(ins, "LearningRate").reshape(())
+    m_out = m + g * g
+    p_out = p - lr * g / (jnp.sqrt(m_out) + attrs["epsilon"])
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register_op("adadelta", inputs=["Param", "Grad", "AvgSquaredGrad",
+                                 "AvgSquaredUpdate"],
+             outputs=["ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"],
+             attrs={"rho": 0.95, "epsilon": 1e-6}, grad=None)
+def _adadelta(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    asg, asu = x(ins, "AvgSquaredGrad"), x(ins, "AvgSquaredUpdate")
+    rho, eps = attrs["rho"], attrs["epsilon"]
+    asg_out = rho * asg + (1 - rho) * g * g
+    update = -jnp.sqrt((asu + eps) / (asg_out + eps)) * g
+    asu_out = rho * asu + (1 - rho) * update * update
+    return {"ParamOut": [p + update], "AvgSquaredGradOut": [asg_out],
+            "AvgSquaredUpdateOut": [asu_out]}
+
+
+@register_op("adamax", inputs=["Param", "Grad", "LearningRate", "Moment",
+                               "InfNorm", "Beta1Pow"],
+             outputs=["ParamOut", "MomentOut", "InfNormOut"],
+             attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8}, grad=None)
+def _adamax(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    lr = x(ins, "LearningRate").reshape(())
+    m, inf = x(ins, "Moment"), x(ins, "InfNorm")
+    b1p = x(ins, "Beta1Pow").reshape(())
+    b1, b2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g) + eps)
+    lr_t = lr / (1 - b1p)
+    return {"ParamOut": [p - lr_t * m_out / inf_out], "MomentOut": [m_out],
+            "InfNormOut": [inf_out]}
+
+
+@register_op("rmsprop", inputs=["Param", "Grad", "MeanSquare", "MeanGrad",
+                                "Moment", "LearningRate"],
+             outputs=["ParamOut", "MomentOut", "MeanSquareOut", "MeanGradOut"],
+             attrs={"decay": 0.9, "momentum": 0.0, "epsilon": 1e-10,
+                    "centered": False},
+             grad=None)
+def _rmsprop(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    ms, mg = x(ins, "MeanSquare"), x(ins, "MeanGrad")
+    mom, lr = x(ins, "Moment"), x(ins, "LearningRate").reshape(())
+    rho, mu, eps = attrs["decay"], attrs["momentum"], attrs["epsilon"]
+    ms_out = rho * ms + (1 - rho) * g * g
+    if attrs.get("centered"):
+        mg_out = rho * mg + (1 - rho) * g
+        denom = ms_out - mg_out * mg_out + eps
+    else:
+        mg_out = mg
+        denom = ms_out + eps
+    mom_out = mu * mom + lr * g / jnp.sqrt(denom)
+    return {"ParamOut": [p - mom_out], "MomentOut": [mom_out],
+            "MeanSquareOut": [ms_out], "MeanGradOut": [mg_out]}
+
+
+@register_op("ftrl", inputs=["Param", "SquaredAccumulator", "LinearAccumulator",
+                             "Grad", "LearningRate"],
+             outputs=["ParamOut", "SquaredAccumOut", "LinearAccumOut"],
+             attrs={"l1": 0.0, "l2": 0.0, "lr_power": -0.5}, grad=None)
+def _ftrl(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    sq, lin = x(ins, "SquaredAccumulator"), x(ins, "LinearAccumulator")
+    lr = x(ins, "LearningRate").reshape(())
+    l1, l2, lrp = attrs["l1"], attrs["l2"], attrs["lr_power"]
+    new_sq = sq + g * g
+    sigma = (jnp.power(new_sq, -lrp) - jnp.power(sq, -lrp)) / lr
+    lin_out = lin + g - sigma * p
+    quad = jnp.power(new_sq, -lrp) / lr + 2 * l2
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    p_out = pre / quad
+    return {"ParamOut": [p_out], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [lin_out]}
+
+
+@register_op("lamb",
+             inputs=["Param", "Grad", "LearningRate", "Moment1", "Moment2",
+                     "Beta1Pow", "Beta2Pow"],
+             outputs=["ParamOut", "Moment1Out", "Moment2Out",
+                      "Beta1PowOut", "Beta2PowOut"],
+             attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-6,
+                    "weight_decay": 0.01},
+             grad=None)
+def _lamb(ctx, ins, attrs):
+    p = x(ins, "Param")
+    g = x(ins, "Grad").astype(p.dtype)
+    lr = x(ins, "LearningRate").reshape(())
+    m1, m2 = x(ins, "Moment1"), x(ins, "Moment2")
+    b1p, b2p = x(ins, "Beta1Pow").reshape(()), x(ins, "Beta2Pow").reshape(())
+    b1, b2, eps, wd = (attrs["beta1"], attrs["beta2"], attrs["epsilon"],
+                       attrs["weight_decay"])
+    m1_out = b1 * m1 + (1 - b1) * g
+    m2_out = b2 * m2 + (1 - b2) * g * g
+    m1_hat = m1_out / (1 - b1p)
+    m2_hat = m2_out / (1 - b2p)
+    r = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return {"ParamOut": [p - lr * ratio * r], "Moment1Out": [m1_out],
+            "Moment2Out": [m2_out],
+            "Beta1PowOut": [(b1p * b1).reshape((1,))],
+            "Beta2PowOut": [(b2p * b2).reshape((1,))]}

@@ -1,0 +1,374 @@
+"""Tensor creation / manipulation ops.
+
+References: paddle/fluid/operators/{fill_constant,uniform_random,
+gaussian_random,assign,reshape,transpose,concat,split,slice,squeeze,
+unsqueeze,stack,expand,gather,scatter,one_hot,lookup_table,top_k,argsort,
+cumsum,shape}_op.* — rebuilt as jnp/lax expressions; random ops draw from the
+ctx PRNG key (threaded per-op via fold_in, replacing cuRAND + global seeds).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import np_dtype
+from .common import IOSpec, out, register_op, x
+
+
+def _shape_from_attr(ins, attrs):
+    """Resolve output shape: ShapeTensor input > shape attr."""
+    shape = list(attrs.get("shape") or [])
+    return tuple(int(s) for s in shape)
+
+
+@register_op("fill_constant", outputs=["Out"],
+             attrs={"shape": [], "value": 0.0, "dtype": "float32", "force_cpu": False})
+def _fill_constant(ctx, ins, attrs):
+    shape = _shape_from_attr(ins, attrs)
+    dt = np_dtype(attrs["dtype"])
+    return out(jnp.full(shape, attrs["value"], dtype=dt))
+
+
+def _infer_like_batch(op, block):
+    # Out has X's shape with input dim 0 replaced; -1 aware
+    xv = block._var_recursive(op.input("Input")[0])
+    shape = list(op.attrs["shape"])
+    idx_in = op.attrs.get("input_dim_idx", 0)
+    idx_out = op.attrs.get("output_dim_idx", 0)
+    shape[idx_out] = xv.shape[idx_in] if xv.shape else -1
+    if block.has_var(op.output("Out")[0]):
+        v = block.var(op.output("Out")[0])
+        v.shape = tuple(shape)
+        v.dtype = op.attrs.get("dtype", "float32")
+
+
+@register_op("fill_constant_batch_size_like", inputs=["Input"], outputs=["Out"],
+             attrs={"shape": [], "value": 0.0, "dtype": "float32",
+                    "input_dim_idx": 0, "output_dim_idx": 0},
+             infer_shape=_infer_like_batch, grad=None)
+def _fill_constant_bsl(ctx, ins, attrs):
+    inp = x(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = inp.shape[attrs.get("input_dim_idx", 0)]
+    return out(jnp.full(tuple(shape), attrs["value"], dtype=np_dtype(attrs["dtype"])))
+
+
+@register_op("fill_zeros_like", inputs=["X"], outputs=["Out"], grad=None)
+def _fill_zeros_like(ctx, ins, attrs):
+    return out(jnp.zeros_like(x(ins)))
+
+
+@register_op("uniform_random", outputs=["Out"],
+             attrs={"shape": [], "min": -1.0, "max": 1.0, "seed": 0,
+                    "dtype": "float32"},
+             needs_rng=True, grad=None)
+def _uniform_random(ctx, ins, attrs):
+    shape = _shape_from_attr(ins, attrs)
+    key = jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng()
+    return out(jax.random.uniform(key, shape, dtype=np_dtype(attrs["dtype"]),
+                                  minval=attrs["min"], maxval=attrs["max"]))
+
+
+@register_op("gaussian_random", outputs=["Out"],
+             attrs={"shape": [], "mean": 0.0, "std": 1.0, "seed": 0,
+                    "dtype": "float32"},
+             needs_rng=True, grad=None)
+def _gaussian_random(ctx, ins, attrs):
+    shape = _shape_from_attr(ins, attrs)
+    key = jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng()
+    sample = jax.random.normal(key, shape, dtype=np_dtype(attrs["dtype"]))
+    return out(sample * attrs["std"] + attrs["mean"])
+
+
+@register_op("truncated_gaussian_random", outputs=["Out"],
+             attrs={"shape": [], "mean": 0.0, "std": 1.0, "seed": 0,
+                    "dtype": "float32"},
+             needs_rng=True, grad=None)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = _shape_from_attr(ins, attrs)
+    key = jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng()
+    sample = jax.random.truncated_normal(key, -2.0, 2.0, shape,
+                                         dtype=np_dtype(attrs["dtype"]))
+    return out(sample * attrs["std"] + attrs["mean"])
+
+
+@register_op("assign", inputs=["X"], outputs=["Out"])
+def _assign(ctx, ins, attrs):
+    return out(x(ins))
+
+
+@register_op("assign_value", outputs=["Out"],
+             attrs={"shape": [], "dtype": "float32", "values": []}, grad=None)
+def _assign_value(ctx, ins, attrs):
+    vals = np.asarray(attrs["values"], dtype=np_dtype(attrs["dtype"]))
+    return out(jnp.asarray(vals.reshape(attrs["shape"])))
+
+
+@register_op("shape", inputs=["Input"], outputs=["Out"], grad=None)
+def _shape(ctx, ins, attrs):
+    return out(jnp.asarray(x(ins, "Input").shape, dtype=jnp.int32))
+
+
+def _infer_reshape(op, block):
+    xv = block._var_recursive(op.input("X")[0])
+    shape = list(op.attrs["shape"])
+    if xv.shape is not None:
+        has_neg = -1 in shape
+        for i, s in enumerate(shape):
+            if s == 0:
+                shape[i] = xv.shape[i]
+        concrete = [s for s in shape if s != -1]
+        if has_neg and all(d != -1 for d in xv.shape):
+            total = int(np.prod(xv.shape))
+            rest = int(np.prod(concrete)) if concrete else 1
+            shape[shape.index(-1)] = total // rest
+    ov = block.var(op.output("Out")[0])
+    ov.shape = tuple(shape)
+    ov.dtype = xv.dtype
+    if op.output("XShape"):
+        xs = block.var(op.output("XShape")[0])
+        xs.shape = (0,) + tuple(xv.shape or ())
+        xs.dtype = xv.dtype
+
+
+@register_op("reshape2", inputs=[IOSpec("X"), IOSpec("Shape", optional=True, no_grad=True)],
+             outputs=["Out", "XShape"], attrs={"shape": []},
+             infer_shape=_infer_reshape)
+def _reshape2(ctx, ins, attrs):
+    xv = x(ins)
+    shape = [xv.shape[i] if s == 0 else s for i, s in enumerate(attrs["shape"])] \
+        if any(s == 0 for s in attrs["shape"]) else list(attrs["shape"])
+    return {"Out": [jnp.reshape(xv, shape)], "XShape": [jnp.zeros((0,), xv.dtype)]}
+
+
+@register_op("transpose2", inputs=["X"], outputs=["Out", "XShape"],
+             attrs={"axis": []})
+def _transpose2(ctx, ins, attrs):
+    xv = x(ins)
+    return {"Out": [jnp.transpose(xv, attrs["axis"])],
+            "XShape": [jnp.zeros((0,), xv.dtype)]}
+
+
+@register_op("concat", inputs=[IOSpec("X", duplicable=True)], outputs=["Out"],
+             attrs={"axis": 0})
+def _concat(ctx, ins, attrs):
+    return out(jnp.concatenate([v for v in ins["X"] if v is not None],
+                               axis=attrs["axis"]))
+
+
+@register_op("split", inputs=["X"], outputs=[IOSpec("Out", duplicable=True)],
+             attrs={"num": 0, "sections": [], "axis": 0})
+def _split(ctx, ins, attrs):
+    xv = x(ins)
+    axis = attrs["axis"]
+    if attrs.get("sections"):
+        idx = np.cumsum(attrs["sections"][:-1]).tolist()
+        parts = jnp.split(xv, idx, axis=axis)
+    else:
+        parts = jnp.split(xv, attrs["num"], axis=axis)
+    return {"Out": list(parts)}
+
+
+@register_op("slice", inputs=["Input"], outputs=["Out"],
+             attrs={"axes": [], "starts": [], "ends": [],
+                    "decrease_axis": []})
+def _slice(ctx, ins, attrs):
+    xv = x(ins, "Input")
+    idx = [slice(None)] * xv.ndim
+    for ax, st, en in zip(attrs["axes"], attrs["starts"], attrs["ends"]):
+        idx[ax] = slice(st, en)
+    res = xv[tuple(idx)]
+    if attrs.get("decrease_axis"):
+        res = jnp.squeeze(res, axis=tuple(attrs["decrease_axis"]))
+    return out(res)
+
+
+@register_op("squeeze2", inputs=["X"], outputs=["Out", "XShape"],
+             attrs={"axes": []})
+def _squeeze2(ctx, ins, attrs):
+    xv = x(ins)
+    axes = tuple(a for a in attrs["axes"] if xv.shape[a] == 1) or tuple(
+        i for i, d in enumerate(xv.shape) if d == 1
+    )
+    return {"Out": [jnp.squeeze(xv, axis=axes)],
+            "XShape": [jnp.zeros((0,), xv.dtype)]}
+
+
+@register_op("unsqueeze2", inputs=["X"], outputs=["Out", "XShape"],
+             attrs={"axes": []})
+def _unsqueeze2(ctx, ins, attrs):
+    xv = x(ins)
+    res = xv
+    for a in sorted(attrs["axes"]):
+        res = jnp.expand_dims(res, a)
+    return {"Out": [res], "XShape": [jnp.zeros((0,), xv.dtype)]}
+
+
+@register_op("stack", inputs=[IOSpec("X", duplicable=True)], outputs=["Y"],
+             attrs={"axis": 0})
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs["axis"])]}
+
+
+@register_op("unstack", inputs=["X"], outputs=[IOSpec("Y", duplicable=True)],
+             attrs={"axis": 0, "num": 0})
+def _unstack(ctx, ins, attrs):
+    xv = x(ins)
+    parts = [jnp.squeeze(p, attrs["axis"])
+             for p in jnp.split(xv, xv.shape[attrs["axis"]], attrs["axis"])]
+    return {"Y": parts}
+
+
+@register_op("expand", inputs=["X"], outputs=["Out"], attrs={"expand_times": []})
+def _expand(ctx, ins, attrs):
+    return out(jnp.tile(x(ins), attrs["expand_times"]))
+
+
+@register_op("gather", inputs=[IOSpec("X"), IOSpec("Index", no_grad=True)],
+             outputs=["Out"])
+def _gather(ctx, ins, attrs):
+    return out(jnp.take(x(ins, "X"), x(ins, "Index").astype(jnp.int32), axis=0))
+
+
+@register_op("scatter", inputs=[IOSpec("X"), IOSpec("Ids", no_grad=True), IOSpec("Updates")],
+             outputs=["Out"], attrs={"overwrite": True})
+def _scatter(ctx, ins, attrs):
+    xv, ids, upd = x(ins, "X"), x(ins, "Ids"), x(ins, "Updates")
+    ids = ids.astype(jnp.int32)
+    if attrs.get("overwrite", True):
+        return out(xv.at[ids].set(upd))
+    return out(xv.at[ids].add(upd))
+
+
+@register_op("one_hot", inputs=[IOSpec("X", no_grad=True)], outputs=["Out"],
+             attrs={"depth": 1, "dtype": "float32"}, grad=None)
+def _one_hot(ctx, ins, attrs):
+    ids = x(ins)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    return out(jax.nn.one_hot(ids.astype(jnp.int32), attrs["depth"],
+                              dtype=np_dtype(attrs["dtype"])))
+
+
+@register_op("lookup_table", inputs=[IOSpec("W"), IOSpec("Ids", no_grad=True)],
+             outputs=["Out"],
+             attrs={"is_sparse": False, "is_distributed": False,
+                    "padding_idx": -1, "remote_prefetch": False})
+def _lookup_table(ctx, ins, attrs):
+    w, ids = x(ins, "W"), x(ins, "Ids")
+    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+    if squeeze_last:
+        ids = jnp.squeeze(ids, -1)
+    emb = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad != -1:
+        mask = (ids != pad)[..., None]
+        emb = jnp.where(mask, emb, 0.0)
+    return out(emb)
+
+
+@register_op("lookup_table_v2", inputs=[IOSpec("W"), IOSpec("Ids", no_grad=True)],
+             outputs=["Out"], attrs={"is_sparse": False, "padding_idx": -1})
+def _lookup_table_v2(ctx, ins, attrs):
+    return _lookup_table(ctx, ins, attrs)
+
+
+@register_op("top_k", inputs=["X"], outputs=["Out", "Indices"], attrs={"k": 1},
+             grad=None)
+def _top_k(ctx, ins, attrs):
+    vals, idx = jax.lax.top_k(x(ins), attrs["k"])
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("arg_max", inputs=["X"], outputs=["Out"], attrs={"axis": -1},
+             grad=None)
+def _arg_max(ctx, ins, attrs):
+    return out(jnp.argmax(x(ins), axis=attrs["axis"]).astype(jnp.int64))
+
+
+@register_op("arg_min", inputs=["X"], outputs=["Out"], attrs={"axis": -1},
+             grad=None)
+def _arg_min(ctx, ins, attrs):
+    return out(jnp.argmin(x(ins), axis=attrs["axis"]).astype(jnp.int64))
+
+
+@register_op("argsort", inputs=["X"], outputs=["Out", "Indices"],
+             attrs={"axis": -1, "descending": False}, grad=None)
+def _argsort(ctx, ins, attrs):
+    xv = x(ins)
+    axis = attrs["axis"]
+    idx = jnp.argsort(xv, axis=axis, descending=attrs.get("descending", False))
+    return {"Out": [jnp.take_along_axis(xv, idx, axis=axis)],
+            "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("cumsum", inputs=["X"], outputs=["Out"],
+             attrs={"axis": -1, "exclusive": False, "reverse": False})
+def _cumsum(ctx, ins, attrs):
+    xv = x(ins)
+    axis = attrs["axis"]
+    if attrs.get("reverse"):
+        xv = jnp.flip(xv, axis)
+    res = jnp.cumsum(xv, axis=axis)
+    if attrs.get("exclusive"):
+        res = res - xv
+    if attrs.get("reverse"):
+        res = jnp.flip(res, axis)
+    return out(res)
+
+
+@register_op("where", inputs=[IOSpec("Condition", no_grad=True), IOSpec("X"), IOSpec("Y")],
+             outputs=["Out"])
+def _where(ctx, ins, attrs):
+    return out(jnp.where(x(ins, "Condition"), x(ins, "X"), x(ins, "Y")))
+
+
+@register_op("range",
+             inputs=[IOSpec("Start", optional=True, no_grad=True),
+                     IOSpec("End", optional=True, no_grad=True),
+                     IOSpec("Step", optional=True, no_grad=True)],
+             outputs=["Out"],
+             attrs={"start": 0.0, "end": 0.0, "step": 1.0, "dtype": "float32",
+                    "use_attrs": True},
+             grad=None)
+def _range(ctx, ins, attrs):
+    """XLA needs a static length; the layer passes numeric bounds as attrs.
+    Tensor inputs are only accepted if they are compile-time constants."""
+    if attrs.get("use_attrs", True):
+        st, en, sp = attrs["start"], attrs["end"], attrs["step"]
+    else:
+        try:
+            st = float(x(ins, "Start"))
+            en = float(x(ins, "End"))
+            sp = float(x(ins, "Step"))
+        except (TypeError, jax.errors.ConcretizationTypeError) as e:
+            raise ValueError(
+                "range op: Start/End/Step must be compile-time constants "
+                "under XLA (static shapes); pass numbers, not computed "
+                "tensors") from e
+    return out(jnp.arange(st, en, sp, dtype=np_dtype(attrs.get("dtype", "float32"))))
+
+
+@register_op("increment", inputs=["X"], outputs=["Out"], attrs={"step": 1.0},
+             grad=None)
+def _increment(ctx, ins, attrs):
+    return out(x(ins) + attrs["step"])
+
+
+@register_op("flatten2", inputs=["X"], outputs=["Out", "XShape"], attrs={"axis": 1})
+def _flatten2(ctx, ins, attrs):
+    xv = x(ins)
+    ax = attrs["axis"]
+    lead = int(np.prod(xv.shape[:ax])) if ax > 0 else 1
+    return {"Out": [jnp.reshape(xv, (lead, -1))],
+            "XShape": [jnp.zeros((0,), xv.dtype)]}
+
+
+@register_op("pad", inputs=["X"], outputs=["Out"],
+             attrs={"paddings": [], "pad_value": 0.0})
+def _pad(ctx, ins, attrs):
+    xv = x(ins)
+    p = attrs["paddings"]
+    cfg = [(p[2 * i], p[2 * i + 1]) for i in range(xv.ndim)]
+    return out(jnp.pad(xv, cfg, constant_values=attrs["pad_value"]))

@@ -1,0 +1,649 @@
+"""Optimizer family (reference: python/paddle/fluid/optimizer.py:53 Optimizer
+base, :634-2360 the 13 concrete optimizers).
+
+Same architecture as the reference: ``minimize`` = ``append_backward`` +
+``apply_gradients``; each optimizer appends per-param update OPS to the main
+program and creates accumulator vars (persistable) initialised in the startup
+program. Because the whole step compiles to one XLA executable, the
+reference's fuse_optimizer_ops/coalesce_grad_tensor passes are unnecessary.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from . import unique_name
+from .backward import append_backward
+from .clip import append_gradient_clip_ops, error_clip_callback
+from .framework import (Parameter, Program, Variable, default_main_program,
+                        default_startup_program, program_guard)
+from .initializer import Constant
+from .layer_helper import LayerHelper
+from .regularizer import append_regularization_ops
+
+__all__ = [
+    "SGD", "Momentum", "Adagrad", "Adam", "Adamax", "AdamW", "DecayedAdagrad",
+    "Adadelta", "RMSProp", "Ftrl", "Lamb", "LarsMomentum",
+    "SGDOptimizer", "MomentumOptimizer", "AdagradOptimizer", "AdamOptimizer",
+    "AdamaxOptimizer", "DecayedAdagradOptimizer", "AdadeltaOptimizer",
+    "RMSPropOptimizer", "FtrlOptimizer", "LambOptimizer",
+    "LarsMomentumOptimizer", "ExponentialMovingAverage", "ModelAverage",
+    "LookaheadOptimizer", "RecomputeOptimizer",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._name = name
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self._learning_rate_var: Optional[Variable] = None
+        self.type = "optimizer"
+
+    # -- learning rate ---------------------------------------------------
+    def _create_global_learning_rate(self):
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_var = self._learning_rate
+            return
+        if self._learning_rate_var is not None:
+            return
+        name = unique_name.generate("learning_rate")
+        main_block = default_main_program().global_block
+        self._learning_rate_var = main_block.create_var(
+            name=name, shape=(1,), dtype="float32", persistable=True,
+            stop_gradient=True)
+        startup = default_startup_program().global_block
+        startup.create_var(name=name, shape=(1,), dtype="float32",
+                           persistable=True)
+        startup.append_op("fill_constant", outputs={"Out": name},
+                          attrs={"shape": [1], "dtype": "float32",
+                                 "value": float(self._learning_rate)})
+
+    def _create_param_lr(self, param_and_grad):
+        param = param_and_grad[0]
+        mult = (param.optimize_attr or {}).get("learning_rate", 1.0)
+        if mult == 1.0:
+            return self._learning_rate_var
+        helper = LayerHelper("param_lr")
+        out = helper.create_variable_for_type_inference("float32", True)
+        helper.append_op("scale", inputs={"X": self._learning_rate_var},
+                         outputs={"Out": out}, attrs={"scale": float(mult)})
+        return out
+
+    # -- accumulators ----------------------------------------------------
+    def _add_accumulator(self, name: str, param: Parameter, dtype=None,
+                         fill_value=0.0, shape=None):
+        if name in self._accumulators and param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        shape = list(shape if shape is not None else param.shape)
+        dtype = dtype or param.dtype
+        var_name = unique_name.generate(f"{name}_{param.name}")
+        main_block = default_main_program().global_block
+        var = main_block.create_var(name=var_name, shape=tuple(shape),
+                                    dtype=dtype, persistable=True,
+                                    stop_gradient=True)
+        startup = default_startup_program().global_block
+        startup.create_var(name=var_name, shape=tuple(shape), dtype=dtype,
+                           persistable=True)
+        startup.append_op("fill_constant", outputs={"Out": var_name},
+                          attrs={"shape": shape, "dtype": dtype,
+                                 "value": float(fill_value)})
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name: str, param: Parameter):
+        return self._accumulators[name][param.name]
+
+    # -- hooks implemented by subclasses ---------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    # -- public API ------------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        # Guard on the program that owns the params, not whatever the global
+        # default happens to be (reference optimizer.py apply_optimize wraps
+        # in program_guard(loss.block.program, startup)).
+        program = params_grads[0][0].block.program
+        with program_guard(program):
+            block = program.global_block
+            params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
+            params_grads = append_gradient_clip_ops(params_grads)
+            params_grads = append_regularization_ops(params_grads,
+                                                     self.regularization)
+            self._create_global_learning_rate()
+            self._create_accumulators(block, [pg[0] for pg in params_grads])
+            optimize_ops = []
+            for pg in params_grads:
+                optimize_ops.append(self._append_optimize_op(block, pg))
+            self._finish_update(block, params_grads)
+        return optimize_ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        with program_guard(program, startup_program):
+            params_grads = self.backward(loss, startup_program,
+                                         parameter_list, no_grad_set)
+            optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "sgd",
+            inputs={"Param": p, "Grad": g,
+                    "LearningRate": self._create_param_lr(param_and_grad)},
+            outputs={"ParamOut": p})
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "momentum"
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        vel = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": vel,
+                    "LearningRate": self._create_param_lr(param_and_grad)},
+            outputs={"ParamOut": p, "VelocityOut": vel},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "lars_momentum"
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        vel = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": vel,
+                    "LearningRate": self._create_param_lr(param_and_grad)},
+            outputs={"ParamOut": p, "VelocityOut": vel},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay})
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, regularization=None,
+                 name=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adagrad"
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "adagrad",
+            inputs={"Param": p, "Grad": g, "Moment": m,
+                    "LearningRate": self._create_param_lr(param_and_grad)},
+            outputs={"ParamOut": p, "MomentOut": m},
+            attrs={"epsilon": self._epsilon})
+
+
+DecayedAdagradOptimizer = AdagradOptimizer  # decay handled via regularization
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None,
+                 lazy_mode=False):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adam"
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, shape=[1],
+                                  fill_value=self._beta1)
+            self._add_accumulator("beta2_pow_acc", p, shape=[1],
+                                  fill_value=self._beta2)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        return block.append_op(
+            self.type if self.type in ("adam", "lamb") else "adam",
+            inputs={"Param": p, "Grad": g,
+                    "LearningRate": self._create_param_lr(param_and_grad),
+                    "Moment1": m1, "Moment2": m2,
+                    "Beta1Pow": b1p, "Beta2Pow": b2p},
+            outputs={"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+                     "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+            attrs=self._op_attrs())
+
+    def _op_attrs(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon}
+
+
+class AdamWOptimizer(AdamOptimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, weight_decay=0.01, regularization=None,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, regularization,
+                         name)
+        self.type = "adamw"
+        self._weight_decay = weight_decay
+
+    def _op_attrs(self):
+        a = super()._op_attrs()
+        a["weight_decay"] = self._weight_decay
+        return a
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        return block.append_op(
+            "adamw",
+            inputs={"Param": p, "Grad": g,
+                    "LearningRate": self._create_param_lr(param_and_grad),
+                    "Moment1": m1, "Moment2": m2,
+                    "Beta1Pow": b1p, "Beta2Pow": b2p},
+            outputs={"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+                     "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+            attrs=self._op_attrs())
+
+
+class LambOptimizer(AdamOptimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, regularization=None,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, regularization,
+                         name)
+        self.type = "lamb"
+        self._weight_decay = lamb_weight_decay
+
+    def _op_attrs(self):
+        a = super()._op_attrs()
+        a["weight_decay"] = self._weight_decay
+        return a
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adamax"
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, shape=[1],
+                                  fill_value=self._beta1)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "adamax",
+            inputs={"Param": p, "Grad": g,
+                    "LearningRate": self._create_param_lr(param_and_grad),
+                    "Moment": self._get_accumulator("moment", p),
+                    "InfNorm": self._get_accumulator("inf_norm", p),
+                    "Beta1Pow": self._get_accumulator("beta1_pow_acc", p)},
+            outputs={"ParamOut": p,
+                     "MomentOut": self._get_accumulator("moment", p),
+                     "InfNormOut": self._get_accumulator("inf_norm", p)},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+
+    def _finish_update(self, block, params_grads):
+        for p, _ in params_grads:
+            b1p = self._get_accumulator("beta1_pow_acc", p)
+            block.append_op("scale", inputs={"X": b1p},
+                            outputs={"Out": b1p},
+                            attrs={"scale": self._beta1})
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adadelta"
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "adadelta",
+            inputs={"Param": p, "Grad": g,
+                    "AvgSquaredGrad": self._get_accumulator("avg_squared_grad", p),
+                    "AvgSquaredUpdate": self._get_accumulator("avg_squared_update", p)},
+            outputs={"ParamOut": p,
+                     "AvgSquaredGradOut": self._get_accumulator("avg_squared_grad", p),
+                     "AvgSquaredUpdateOut": self._get_accumulator("avg_squared_update", p)},
+            attrs={"epsilon": self._epsilon, "rho": self._rho})
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "rmsprop"
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+            self._add_accumulator("momentum", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "rmsprop",
+            inputs={"Param": p, "Grad": g,
+                    "MeanSquare": self._get_accumulator("mean_square", p),
+                    "MeanGrad": self._get_accumulator("mean_grad", p),
+                    "Moment": self._get_accumulator("momentum", p),
+                    "LearningRate": self._create_param_lr(param_and_grad)},
+            outputs={"ParamOut": p,
+                     "MomentOut": self._get_accumulator("momentum", p),
+                     "MeanSquareOut": self._get_accumulator("mean_square", p),
+                     "MeanGradOut": self._get_accumulator("mean_grad", p)},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered})
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "ftrl"
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "ftrl",
+            inputs={"Param": p, "Grad": g,
+                    "SquaredAccumulator": self._get_accumulator("squared", p),
+                    "LinearAccumulator": self._get_accumulator("linear", p),
+                    "LearningRate": self._create_param_lr(param_and_grad)},
+            outputs={"ParamOut": p,
+                     "SquaredAccumOut": self._get_accumulator("squared", p),
+                     "LinearAccumOut": self._get_accumulator("linear", p)},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power})
+
+
+# ---------------------------------------------------------------------------
+# Meta optimizers / averaging (reference optimizer.py:2361-3367)
+# ---------------------------------------------------------------------------
+
+class ExponentialMovingAverage:
+    """EMA of params (reference optimizer.py:2551). Maintains shadow vars
+    updated by ops appended to the main program; apply()/restore() are
+    context managers swapping params <-> shadow in the scope."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or ""
+        self._ema_vars: Dict[str, Variable] = {}
+        self._params: List[Parameter] = []
+        program = default_main_program()
+        block = program.global_block
+        for p in program.all_parameters():
+            if not p.trainable:
+                continue
+            self._params.append(p)
+            ema_name = self._name + p.name + ".ema"
+            ema = block.create_var(name=ema_name, shape=p.shape,
+                                   dtype=p.dtype, persistable=True,
+                                   stop_gradient=True)
+            startup = default_startup_program().global_block
+            startup.create_var(name=ema_name, shape=p.shape, dtype=p.dtype,
+                               persistable=True)
+            startup.append_op("fill_constant", outputs={"Out": ema_name},
+                              attrs={"shape": list(p.shape),
+                                     "dtype": p.dtype, "value": 0.0})
+            self._ema_vars[p.name] = ema
+            # ema = decay*ema + (1-decay)*param
+            tmp = block.create_var(
+                name=unique_name.generate(ema_name + ".tmp"),
+                shape=p.shape, dtype=p.dtype, stop_gradient=True)
+            block.append_op("scale", inputs={"X": ema}, outputs={"Out": tmp},
+                            attrs={"scale": decay})
+            tmp2 = block.create_var(
+                name=unique_name.generate(ema_name + ".tmp"),
+                shape=p.shape, dtype=p.dtype, stop_gradient=True)
+            block.append_op("scale", inputs={"X": p}, outputs={"Out": tmp2},
+                            attrs={"scale": 1.0 - decay})
+            block.append_op("sum", inputs={"X": [tmp, tmp2]},
+                            outputs={"Out": ema})
+
+    def update(self):
+        pass  # updates are appended into the main program at construction
+
+    import contextlib as _ctx
+
+    @_ctx.contextmanager
+    def apply(self, executor, need_restore=True):
+        from .executor import global_scope
+
+        scope = global_scope()
+        saved = {}
+        for p in self._params:
+            ema = self._ema_vars[p.name]
+            saved[p.name] = scope.find_var(p.name)
+            v = scope.find_var(ema.name)
+            if v is not None:
+                scope.set_var(p.name, v)
+        try:
+            yield
+        finally:
+            if need_restore:
+                for name, v in saved.items():
+                    scope.set_var(name, v)
+
+    def restore(self, executor):
+        pass
+
+
+class ModelAverage(Optimizer):
+    """reference optimizer.py:2361 — running average of params; simplified to
+    EMA-style accumulation with uniform weights over a window."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization, name)
+        self._window = max_average_window
+        self._ema = None
+
+    def minimize(self, loss, **kw):
+        raise RuntimeError("ModelAverage wraps a trained program; call apply()")
+
+    def apply(self, executor, need_restore=True):
+        if self._ema is None:
+            self._ema = ExponentialMovingAverage(
+                decay=1.0 - 1.0 / max(self._window, 1))
+        return self._ema.apply(executor, need_restore)
+
+    def restore(self, executor):
+        pass
+
+
+class LookaheadOptimizer:
+    """reference optimizer.py:3367: slow/fast weights. slow_k sync period."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        ops, pgs = self.inner_optimizer.minimize(
+            loss, startup_program=startup_program)
+        program = default_main_program()
+        block = program.global_block
+        startup = default_startup_program().global_block
+        # step counter
+        step_name = unique_name.generate("lookahead_step")
+        block.create_var(name=step_name, shape=(1,), dtype="float32",
+                         persistable=True, stop_gradient=True)
+        startup.create_var(name=step_name, shape=(1,), dtype="float32",
+                           persistable=True)
+        startup.append_op("fill_constant", outputs={"Out": step_name},
+                          attrs={"shape": [1], "dtype": "float32", "value": 0.0})
+        block.append_op("increment", inputs={"X": step_name},
+                        outputs={"Out": step_name}, attrs={"step": 1.0})
+        for p, _ in pgs:
+            slow_name = p.name + ".slow"
+            block.create_var(name=slow_name, shape=p.shape, dtype=p.dtype,
+                             persistable=True, stop_gradient=True)
+            startup.create_var(name=slow_name, shape=p.shape, dtype=p.dtype,
+                               persistable=True)
+            # initialize slow = fast initial value: copy via assign after init
+            startup.append_op("assign", inputs={"X": p.name},
+                              outputs={"Out": slow_name})
+            # every k steps: slow += alpha*(fast-slow); fast = slow.
+            # branch-free gate: frac(step/k) == 0
+            helper = LayerHelper("lookahead")
+            inv = helper.create_variable_for_type_inference("float32", True)
+            block.append_op("scale", inputs={"X": step_name},
+                            outputs={"Out": inv}, attrs={"scale": 1.0 / self.k})
+            flo = helper.create_variable_for_type_inference("float32", True)
+            block.append_op("floor", inputs={"X": inv}, outputs={"Out": flo})
+            frac = helper.create_variable_for_type_inference("float32", True)
+            block.append_op("elementwise_sub", inputs={"X": inv, "Y": flo},
+                            outputs={"Out": frac}, attrs={"axis": -1})
+            # is_sync = 1 if frac == 0
+            iszero = helper.create_variable_for_type_inference("bool", True)
+            zero = helper.create_variable_for_type_inference("float32", True)
+            block.append_op("fill_constant", outputs={"Out": zero},
+                            attrs={"shape": [1], "dtype": "float32",
+                                   "value": 0.0})
+            block.append_op("equal", inputs={"X": frac, "Y": zero},
+                            outputs={"Out": iszero})
+            gate = helper.create_variable_for_type_inference("float32", True)
+            block.append_op("cast", inputs={"X": iszero},
+                            outputs={"Out": gate},
+                            attrs={"in_dtype": "bool", "out_dtype": "float32"})
+            # new_slow = slow + gate*alpha*(fast - slow)
+            diff = helper.create_variable_for_type_inference(p.dtype, True)
+            block.append_op("elementwise_sub", inputs={"X": p.name,
+                                                       "Y": slow_name},
+                            outputs={"Out": diff}, attrs={"axis": -1})
+            sdiff = helper.create_variable_for_type_inference(p.dtype, True)
+            block.append_op("scale", inputs={"X": diff}, outputs={"Out": sdiff},
+                            attrs={"scale": self.alpha})
+            gated = helper.create_variable_for_type_inference(p.dtype, True)
+            block.append_op("elementwise_mul", inputs={"X": sdiff, "Y": gate},
+                            outputs={"Out": gated}, attrs={"axis": 0})
+            block.append_op("sum", inputs={"X": [slow_name, gated]},
+                            outputs={"Out": slow_name})
+            # new_fast = gate*slow + (1-gate)*fast
+            #          = fast + gate*(slow - fast)
+            diff2 = helper.create_variable_for_type_inference(p.dtype, True)
+            block.append_op("elementwise_sub", inputs={"X": slow_name,
+                                                       "Y": p.name},
+                            outputs={"Out": diff2}, attrs={"axis": -1})
+            gated2 = helper.create_variable_for_type_inference(p.dtype, True)
+            block.append_op("elementwise_mul", inputs={"X": diff2, "Y": gate},
+                            outputs={"Out": gated2}, attrs={"axis": 0})
+            block.append_op("sum", inputs={"X": [p.name, gated2]},
+                            outputs={"Out": p.name})
+        return ops, pgs
+
+
+class RecomputeOptimizer:
+    """reference optimizer.py:3074. On TPU the memory lever is
+    jax.checkpoint over segments; at the program level we accept the
+    checkpoints list for API parity and rely on XLA rematerialisation
+    (a segment-level jax.checkpoint pass is tracked for the trainer path)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def backward(self, loss, **kw):
+        return self._optimizer.backward(loss, **kw)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, **kw):
+        return self._optimizer.minimize(loss, **kw)
+
+
+# canonical short aliases (v2-style names)
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer

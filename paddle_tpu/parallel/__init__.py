@@ -1,0 +1,9 @@
+"""Distributed execution: device meshes, data/model parallel compilation.
+
+TPU-native replacement for the reference's ParallelExecutor + NCCL stack
+(paddle/fluid/framework/parallel_executor.cc, platform/nccl_helper.h): instead
+of an SSA graph with AllReduceOpHandles, programs compile once under jit with
+sharding annotations over a jax.sharding.Mesh and XLA inserts the collectives
+over ICI/DCN.
+"""
+from .compiled_program import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401

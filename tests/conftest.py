@@ -1,0 +1,12 @@
+"""Test env: force the JAX CPU backend with 8 virtual devices so multi-chip
+sharding paths compile and run without TPU hardware (SURVEY.md §4: the
+fake-device story the reference lacks). MUST run before jax initialises."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# fp64 off (TPU-like); tests use fp32 tolerances
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,80 @@
+"""End-to-end slice: MNIST-style MLP trains and the loss drops
+(BASELINE config #1; reference analogue: tests/book/test_recognize_digits.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _build_mlp():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[784], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(img, 64, act="relu")
+        h = fluid.layers.fc(h, 32, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+        avg_loss = fluid.layers.mean(loss)
+        acc = fluid.layers.accuracy(logits, label)
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(avg_loss)
+    return main, startup, avg_loss, acc
+
+
+def test_mnist_mlp_loss_decreases():
+    main, startup, avg_loss, acc = _build_mlp()
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # synthetic separable task: class = argmax of 10 fixed projections
+        proj = rng.randn(784, 10).astype(np.float32)
+        losses = []
+        for step in range(80):
+            xb = rng.randn(64, 784).astype(np.float32)
+            yb = np.argmax(xb @ proj, axis=1).astype(np.int64)[:, None]
+            loss_v, acc_v = exe.run(main, feed={"img": xb, "label": yb},
+                                    fetch_list=[avg_loss, acc])
+            losses.append(float(loss_v))
+    assert losses[0] > losses[-1], f"loss did not decrease: {losses}"
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_program_serialization_roundtrip():
+    main, startup, avg_loss, acc = _build_mlp()
+    js = main.to_json()
+    main2 = fluid.Program.from_json(js)
+    assert len(main2.global_block.ops) == len(main.global_block.ops)
+    assert sorted(main2.global_block.vars) == sorted(main.global_block.vars)
+
+
+def test_adam_trains():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(1)
+    w_true = rng.randn(8, 1).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        first = last = None
+        for i in range(60):
+            xb = rng.randn(32, 8).astype(np.float32)
+            yb = xb @ w_true
+            (lv,) = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            if first is None:
+                first = float(lv)
+            last = float(lv)
+    assert last < first * 0.2, (first, last)
