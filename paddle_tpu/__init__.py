@@ -18,9 +18,13 @@ from .framework import (Block, Operator, Parameter, Program, Variable,
                         in_dygraph_mode, name_scope, program_guard)
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .parallel import BuildStrategy, CompiledProgram, ExecutionStrategy
+from . import io
+from . import metrics
 from . import optimizer
+from . import profiler
 from . import regularizer
 from .core import registry as op_registry
+from .layers import learning_rate_scheduler  # registers fluid.layers.* decays
 
 __version__ = "0.1.0"
 

@@ -139,6 +139,56 @@ class _CompiledStep:
         self.program = None
 
 
+def analyze_block_io(block, feed_names: set, fetch_names) -> dict:
+    """Classify the vars a compiled step reads/writes.
+
+    Returns feed_order, state_in (scope vars read), state_out (persistables
+    written), donated (read AND written — safe to donate), ro (read-only).
+    Shared by Executor, CompiledProgram and the sharded trainer paths.
+    """
+    produced: set = set()
+    state_in: List[str] = []
+    state_out: List[str] = []
+    for op in block.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        for name in op.input_arg_names:
+            if (name not in produced and name not in feed_names
+                    and name not in state_in and name != "@EMPTY@"):
+                state_in.append(name)
+        for name in op.output_arg_names:
+            if name == "@EMPTY@":
+                continue
+            produced.add(name)
+            is_persistable = block.has_var(name) and block.var(name).persistable
+            if is_persistable and name not in state_out:
+                state_out.append(name)
+    for n in fetch_names:
+        if n not in produced and n not in feed_names and n not in state_in:
+            state_in.append(n)
+    donated = [n for n in state_in if n in state_out]
+    ro = [n for n in state_in if n not in state_out]
+    return {"feed_order": sorted(feed_names), "state_in": state_in,
+            "state_out": state_out, "donated": donated, "ro": ro}
+
+
+def make_step_fn(block, io: dict, fetch_names, mesh=None):
+    """The traced step body shared by all execution paths."""
+
+    def step_fn(feed_vals, donated_vals, ro_vals, rng_key):
+        env: Dict[str, Any] = {}
+        env.update(zip(io["feed_order"], feed_vals))
+        env.update(zip(io["donated"], donated_vals))
+        env.update(zip(io["ro"], ro_vals))
+        ctx = LowerCtx(base_key=rng_key, mesh=mesh)
+        lower_block(block, env, ctx)
+        fetches = [env[n] for n in fetch_names]
+        new_state = [env[n] for n in io["state_out"]]
+        return fetches, new_state
+
+    return step_fn
+
+
 class Executor:
     """Reference API (executor.py:380): run / close; plus train loop helpers."""
 
@@ -168,7 +218,8 @@ class Executor:
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in (fetch_list or [])]
 
-        step = self._get_compiled(program, feed, fetch_names, scope)
+        step = self._get_compiled(program, feed, fetch_names, scope,
+                                  use_cache=use_program_cache)
         feed_vals = [self._to_device_array(feed[n], program, n)
                      for n in step.feed_names]
 
@@ -224,14 +275,15 @@ class Executor:
         return (id(program), program._uid_counter,
                 sum(len(b.ops) for b in program.blocks))
 
-    def _get_compiled(self, program, feed, fetch_names, scope) -> _CompiledStep:
+    def _get_compiled(self, program, feed, fetch_names, scope,
+                      use_cache: bool = True) -> _CompiledStep:
         feed_sig = tuple(sorted(
             (n, tuple(np.asarray(v).shape), str(np.asarray(v).dtype))
             for n, v in feed.items()
         ))
         key = (self._program_fingerprint(program), feed_sig,
                tuple(fetch_names), id(scope))
-        if key in self._cache:
+        if use_cache and key in self._cache:
             return self._cache[key]
         step = self._compile(program, set(feed.keys()), fetch_names, scope)
         step.program = program
@@ -240,44 +292,8 @@ class Executor:
 
     def _compile(self, program: Program, feed_names: set, fetch_names, scope):
         block = program.global_block
-        produced: set = set()
-        state_in: List[str] = []
-        state_out: List[str] = []
-
-        for op in block.ops:
-            if op.type in ("feed", "fetch"):
-                continue
-            for name in op.input_arg_names:
-                if (name not in produced and name not in feed_names
-                        and name not in state_in and name != "@EMPTY@"):
-                    state_in.append(name)
-            for name in op.output_arg_names:
-                if name == "@EMPTY@":
-                    continue
-                produced.add(name)
-                is_persistable = block.has_var(name) and block.var(name).persistable
-                if is_persistable and name not in state_out:
-                    state_out.append(name)
-        # fetches of pure scope vars (e.g. fetch a param) work too
-        for n in fetch_names:
-            if n not in produced and n not in feed_names and n not in state_in:
-                state_in.append(n)
-
-        donated = [n for n in state_in if n in state_out]
-        ro = [n for n in state_in if n not in state_out]
-        feed_order = sorted(feed_names)
-
-        def step_fn(feed_vals, donated_vals, ro_vals, rng_key):
-            env: Dict[str, Any] = {}
-            env.update(zip(feed_order, feed_vals))
-            env.update(zip(donated, donated_vals))
-            env.update(zip(ro, ro_vals))
-            ctx = LowerCtx(base_key=rng_key)
-            lower_block(block, env, ctx)
-            fetches = [env[n] for n in fetch_names]
-            new_state = [env[n] for n in state_out]
-            return fetches, new_state
-
+        io = analyze_block_io(block, feed_names, fetch_names)
+        step_fn = make_step_fn(block, io, fetch_names)
         jitted = jax.jit(step_fn, donate_argnums=(1,))
-        return _CompiledStep(jitted, feed_order, donated, ro, state_out,
-                             tuple(fetch_names))
+        return _CompiledStep(jitted, io["feed_order"], io["donated"], io["ro"],
+                             io["state_out"], tuple(fetch_names))

@@ -185,6 +185,11 @@ class Parameter(Variable):
         d["is_parameter"] = True
         d["trainable"] = self.trainable
         d["optimize_attr"] = self.optimize_attr
+        if self.regularizer is not None:
+            d["regularizer"] = {
+                "type": type(self.regularizer).__name__,
+                "coeff": getattr(self.regularizer, "_coeff", 0.0),
+            }
         return d
 
 
@@ -458,12 +463,23 @@ class Program:
         for b, bd in zip(p.blocks, d["blocks"]):
             for vd in bd["vars"]:
                 if vd.get("is_parameter"):
+                    reg = None
+                    if vd.get("regularizer"):
+                        from . import regularizer as reg_mod
+
+                        reg_cls = getattr(reg_mod, vd["regularizer"]["type"],
+                                          None)
+                        if reg_cls is not None:
+                            reg = reg_cls(vd["regularizer"]["coeff"])
                     param = Parameter(
                         b,
                         vd["name"],
                         vd["shape"],
                         vd["dtype"],
                         trainable=vd.get("trainable", True),
+                        optimize_attr=vd.get("optimize_attr",
+                                             {"learning_rate": 1.0}),
+                        regularizer=reg,
                     )
                     param.stop_gradient = vd.get("stop_gradient", False)
                     b.vars[vd["name"]] = param

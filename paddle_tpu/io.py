@@ -1,0 +1,208 @@
+"""Checkpointing: save/load params, persistables, inference models.
+
+Reference: python/paddle/fluid/io.py (save_vars :130, save_params :263,
+save_persistables :496, load_vars :546, save_inference_model :965,
+load_inference_model :1157). The reference emits save/load OPS and runs a
+save program; here the executor scope already holds jax arrays, so
+checkpointing is a direct (sharding-aware) serialization of scope state plus
+the serialized Program — the orbax-style pytree checkpoint in fluid clothing.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from .executor import Scope, global_scope
+from .framework import Parameter, Program, Variable
+
+__all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
+           "load_params", "load_persistables", "save_inference_model",
+           "load_inference_model", "save_checkpoint", "load_checkpoint"]
+
+_MANIFEST = "manifest.json"
+
+
+def _vars_of(program: Program, predicate) -> List[Variable]:
+    return [v for v in program.list_vars() if predicate(v)]
+
+
+def _save_var_list(executor, dirname: str, vars_: List[Variable],
+                   scope: Optional[Scope], filename: Optional[str]):
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    manifest = {}
+    blobs = {}
+    for v in vars_:
+        val = scope.find_var(v.name)
+        if val is None:
+            raise RuntimeError(f"save: variable '{v.name}' has no value in scope")
+        arr = np.asarray(val)
+        blobs[v.name] = arr
+        manifest[v.name] = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+    if filename is None:
+        for name, arr in blobs.items():
+            np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"),
+                    arr, allow_pickle=False)
+    else:
+        with open(os.path.join(dirname, filename), "wb") as f:
+            pickle.dump(blobs, f, protocol=4)
+    with open(os.path.join(dirname, _MANIFEST), "w") as f:
+        json.dump({"vars": manifest, "filename": filename}, f)
+
+
+def _load_var_list(executor, dirname: str, vars_: List[Variable],
+                   scope: Optional[Scope], filename: Optional[str]):
+    import jax.numpy as jnp
+
+    scope = scope or global_scope()
+    manifest_path = os.path.join(dirname, _MANIFEST)
+    combined = None
+    if filename is not None or (os.path.exists(manifest_path) and
+                                json.load(open(manifest_path)).get("filename")):
+        fname = filename or json.load(open(manifest_path))["filename"]
+        with open(os.path.join(dirname, fname), "rb") as f:
+            combined = pickle.load(f)
+    for v in vars_:
+        if combined is not None:
+            if v.name not in combined:
+                raise RuntimeError(f"load: '{v.name}' missing from checkpoint")
+            arr = combined[v.name]
+        else:
+            path = os.path.join(dirname, v.name.replace("/", "__") + ".npy")
+            if not os.path.exists(path):
+                raise RuntimeError(f"load: '{path}' not found")
+            arr = np.load(path)
+        if v.shape is not None and tuple(arr.shape) != tuple(v.shape) \
+                and -1 not in (v.shape or ()):
+            raise RuntimeError(
+                f"load: shape mismatch for '{v.name}': checkpoint "
+                f"{arr.shape} vs program {v.shape}")
+        scope.set_var(v.name, jnp.asarray(arr))
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    from .framework import default_main_program
+
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = _vars_of(program, predicate or (lambda v: v.persistable))
+    _save_var_list(executor, dirname, vars, scope, filename)
+
+
+def save_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    save_vars(executor, dirname, main_program,
+              predicate=lambda v: isinstance(v, Parameter), filename=filename,
+              scope=scope)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    save_vars(executor, dirname, main_program,
+              predicate=lambda v: v.persistable, filename=filename,
+              scope=scope)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    from .framework import default_main_program
+
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = _vars_of(program, predicate or (lambda v: v.persistable))
+    _load_var_list(executor, dirname, vars, scope, filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    load_vars(executor, dirname, main_program,
+              predicate=lambda v: isinstance(v, Parameter), filename=filename,
+              scope=scope)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    load_vars(executor, dirname, main_program,
+              predicate=lambda v: v.persistable, filename=filename,
+              scope=scope)
+
+
+# ---------------------------------------------------------------------------
+# Inference model export (reference io.py:965 prunes to feed/fetch + saves)
+# ---------------------------------------------------------------------------
+
+def _prune_for_inference(program: Program, feed_names, fetch_names) -> Program:
+    """Keep only ops on the path from feeds to fetches (reference Prune,
+    framework/prune.cc)."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        if any(n in needed for n in op.output_arg_names):
+            keep.append(op)
+            needed.update(op.input_arg_names)
+    block.ops = list(reversed(keep))
+    used = set()
+    for op in block.ops:
+        used.update(op.input_arg_names)
+        used.update(op.output_arg_names)
+    used.update(feed_names)
+    used.update(fetch_names)
+    block.vars = {n: v for n, v in block.vars.items() if n in used}
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, scope=None):
+    from .framework import default_main_program
+
+    program = main_program or default_main_program()
+    fetch_names = [t.name if isinstance(t, Variable) else t
+                   for t in target_vars]
+    pruned = _prune_for_inference(program, feeded_var_names, fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "w") as f:
+        json.dump({"program": pruned.to_dict(),
+                   "feed_names": list(feeded_var_names),
+                   "fetch_names": fetch_names}, f)
+    params = [v for v in pruned.list_vars() if v.persistable]
+    _save_var_list(executor, os.path.join(dirname, "params"), params, scope,
+                   params_filename)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, scope=None):
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename)) as f:
+        meta = json.load(f)
+    program = Program.from_dict(meta["program"])
+    params = [v for v in program.list_vars() if v.persistable]
+    _load_var_list(executor, os.path.join(dirname, "params"), params, scope,
+                   params_filename)
+    fetch_vars = [program.global_block.var(n) for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars
+
+
+# convenience full-checkpoint helpers (beyond the reference: adds step/meta)
+def save_checkpoint(executor, dirname, main_program=None, scope=None,
+                    meta: dict = None):
+    save_persistables(executor, dirname, main_program, filename="ckpt.pkl",
+                      scope=scope)
+    with open(os.path.join(dirname, "meta.json"), "w") as f:
+        json.dump(meta or {}, f)
+
+
+def load_checkpoint(executor, dirname, main_program=None, scope=None) -> dict:
+    load_persistables(executor, dirname, main_program, filename="ckpt.pkl",
+                      scope=scope)
+    meta_path = os.path.join(dirname, "meta.json")
+    return json.load(open(meta_path)) if os.path.exists(meta_path) else {}

@@ -8,3 +8,7 @@ from .tensor import (assign, create_global_var, create_tensor,  # noqa: F401
                      uniform_random, zeros, zeros_like)
 from . import nn  # noqa: F401
 from . import tensor  # noqa: F401
+from .learning_rate_scheduler import (cosine_decay, exponential_decay,  # noqa: F401
+                                      inverse_time_decay, linear_lr_warmup,
+                                      natural_exp_decay, noam_decay,
+                                      piecewise_decay, polynomial_decay)

@@ -1,0 +1,9 @@
+"""Model zoo: the five BASELINE.json configs built with the fluid-style API.
+
+These mirror the reference's book/ test models and benchmark configs
+(reference: python/paddle/fluid/tests/book/, BASELINE.md):
+MNIST MLP, ResNet-50, BERT, Transformer NMT, DeepFM CTR.
+"""
+from .mlp import build_mnist_mlp  # noqa: F401
+from .resnet import build_resnet  # noqa: F401
+from .bert import BertConfig, build_bert_pretrain  # noqa: F401

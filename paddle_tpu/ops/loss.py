@@ -85,8 +85,11 @@ def _cross_entropy(ctx, ins, attrs):
         y = -jnp.sum(label * jnp.log(xv + eps), axis=-1, keepdims=True)
     else:
         lbl = label if label.ndim == xv.ndim else jnp.expand_dims(label, -1)
-        picked = jnp.take_along_axis(xv, lbl.astype(jnp.int32), axis=-1)
-        y = -jnp.log(picked + eps)
+        lbl = lbl.astype(jnp.int32)
+        ig = attrs.get("ignore_index", -100)
+        ignored = lbl == ig
+        picked = jnp.take_along_axis(xv, jnp.where(ignored, 0, lbl), axis=-1)
+        y = jnp.where(ignored, 0.0, -jnp.log(picked + eps))
     return {"Y": [y]}
 
 
@@ -96,6 +99,11 @@ def _cross_entropy(ctx, ins, attrs):
 def _sigmoid_ce(ctx, ins, attrs):
     xv, lbl = x(ins, "X"), x(ins, "Label")
     loss = jnp.maximum(xv, 0) - xv * lbl + jnp.log1p(jnp.exp(-jnp.abs(xv)))
+    ignored = lbl == attrs.get("ignore_index", -100)
+    loss = jnp.where(ignored, 0.0, loss)
+    if attrs.get("normalize"):
+        valid = jnp.maximum(jnp.sum((~ignored).astype(loss.dtype)), 1.0)
+        loss = loss / valid
     return out(loss)
 
 

@@ -188,10 +188,13 @@ def _slice(ctx, ins, attrs):
              attrs={"axes": []})
 def _squeeze2(ctx, ins, attrs):
     xv = x(ins)
-    axes = tuple(a for a in attrs["axes"] if xv.shape[a] == 1) or tuple(
-        i for i, d in enumerate(xv.shape) if d == 1
-    )
-    return {"Out": [jnp.squeeze(xv, axis=axes)],
+    if attrs["axes"]:
+        # reference squeeze_op: listed axes are squeezed only if size-1;
+        # non-1 listed axes are ignored (never fall back to squeezing all)
+        axes = tuple(a for a in attrs["axes"] if xv.shape[a] == 1)
+    else:
+        axes = tuple(i for i, d in enumerate(xv.shape) if d == 1)
+    return {"Out": [jnp.squeeze(xv, axis=axes) if axes else xv],
             "XShape": [jnp.zeros((0,), xv.dtype)]}
 
 

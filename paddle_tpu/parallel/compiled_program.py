@@ -136,52 +136,22 @@ class CompiledProgram:
     def _compile(self, program: Program, feed_names: set, fetch_names, scope):
         """Same env-threading as Executor._compile, but jitted with shardings
         over the mesh: feeds split on 'dp', state replicated."""
-        from ..executor import _CompiledStep
+        from ..executor import _CompiledStep, analyze_block_io, make_step_fn
 
         block = program.global_block
-        produced, state_in, state_out = set(), [], []
-        for op in block.ops:
-            if op.type in ("feed", "fetch"):
-                continue
-            for name in op.input_arg_names:
-                if (name not in produced and name not in feed_names
-                        and name not in state_in and name != "@EMPTY@"):
-                    state_in.append(name)
-            for name in op.output_arg_names:
-                if name == "@EMPTY@":
-                    continue
-                produced.add(name)
-                if (block.has_var(name) and block.var(name).persistable
-                        and name not in state_out):
-                    state_out.append(name)
-        for n in fetch_names:
-            if n not in produced and n not in feed_names and n not in state_in:
-                state_in.append(n)
-
-        donated = [n for n in state_in if n in state_out]
-        ro = [n for n in state_in if n not in state_out]
-        feed_order = sorted(feed_names)
+        io = analyze_block_io(block, feed_names, fetch_names)
         mesh = self._mesh
+        step_fn = make_step_fn(block, io, fetch_names, mesh=mesh)
 
         batch_spec = NamedSharding(mesh, P("dp"))
         repl_spec = NamedSharding(mesh, P())
-
-        def step_fn(feed_vals, donated_vals, ro_vals, rng_key):
-            env: Dict[str, Any] = {}
-            env.update(zip(feed_order, feed_vals))
-            env.update(zip(donated, donated_vals))
-            env.update(zip(ro, ro_vals))
-            ctx = LowerCtx(base_key=rng_key, mesh=mesh)
-            lower_block(block, env, ctx)
-            return [env[n] for n in fetch_names], [env[n] for n in state_out]
-
         in_shardings = (
-            [batch_spec] * len(feed_order),
-            [repl_spec] * len(donated),
-            [repl_spec] * len(ro),
+            [batch_spec] * len(io["feed_order"]),
+            [repl_spec] * len(io["donated"]),
+            [repl_spec] * len(io["ro"]),
             None,
         )
         jitted = jax.jit(step_fn, donate_argnums=(1,),
                          in_shardings=in_shardings)
-        return _CompiledStep(jitted, feed_order, donated, ro, state_out,
-                             tuple(fetch_names))
+        return _CompiledStep(jitted, io["feed_order"], io["donated"],
+                             io["ro"], io["state_out"], tuple(fetch_names))

@@ -1,0 +1,96 @@
+"""Profiler: fluid.profiler API over jax.profiler.
+
+Reference: python/paddle/fluid/profiler.py (:225 profiler context manager,
+:127 start_profiler, :168 stop_profiler) and the C++ RecordEvent/CUPTI
+tracer (platform/profiler.h, device_tracer.h). On TPU the equivalent
+substrate is the XLA/XPlane trace: jax.profiler.trace writes a TensorBoard-
+loadable (and Perfetto-convertible) dump — the tools/timeline.py role.
+Op-level host annotations use jax.profiler.TraceAnnotation, the RecordEvent
+analogue.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+           "RecordEvent", "cuda_profiler", "npu_profiler"]
+
+_trace_dir = None
+_host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+
+
+def start_profiler(state="All", tracer_option=None, profile_path="/tmp/profile"):
+    global _trace_dir
+    _trace_dir = profile_path
+    jax.profiler.start_trace(profile_path)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    global _trace_dir
+    jax.profiler.stop_trace()
+    _trace_dir = None
+    _print_host_report(sorted_key)
+
+
+def reset_profiler():
+    _host_events.clear()
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option=None):
+    start_profiler(state, tracer_option, profile_path)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+class RecordEvent:
+    """Host-side RAII marker (reference platform/profiler.h:81); shows up in
+    the XPlane trace as a TraceAnnotation and in the host-side table."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ann.__exit__(*exc)
+        rec = _host_events[self.name]
+        rec[0] += 1
+        rec[1] += time.perf_counter() - self._t0
+        return False
+
+
+def _print_host_report(sorted_key=None):
+    if not _host_events:
+        return
+    rows = [(name, cnt, tot, tot / cnt)
+            for name, (cnt, tot) in _host_events.items()]
+    if sorted_key in ("total", None):
+        rows.sort(key=lambda r: -r[2])
+    elif sorted_key == "calls":
+        rows.sort(key=lambda r: -r[1])
+    elif sorted_key == "ave":
+        rows.sort(key=lambda r: -r[3])
+    print(f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(s)':>12}")
+    for name, cnt, tot, avg in rows:
+        print(f"{name:<40}{cnt:>8}{tot:>12.6f}{avg:>12.6f}")
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **k):
+    """Compat no-op (reference profiler.py:39): TPU has no nvprof."""
+    yield
+
+
+npu_profiler = cuda_profiler

@@ -1,12 +1,20 @@
 """Test env: force the JAX CPU backend with 8 virtual devices so multi-chip
 sharding paths compile and run without TPU hardware (SURVEY.md §4: the
-fake-device story the reference lacks). MUST run before jax initialises."""
+fake-device story the reference lacks).
+
+NOTE: this environment's sitecustomize (axon TPU tunnel) imports jax at
+interpreter startup, so setting env vars here is too late — use jax.config
+updates instead, which work as long as no backend is initialized yet."""
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-# fp64 off (TPU-like); tests use fp32 tolerances
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# persistent XLA compile cache: op-test programs compile once ever
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(__file__), ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

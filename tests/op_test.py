@@ -1,0 +1,153 @@
+"""OpTest harness: numpy-oracle correctness + numeric-gradient checks.
+
+Port of the reference's keystone test base class
+(python/paddle/fluid/tests/unittests/op_test.py:135): a subclass declares
+``op_type``, ``inputs``, ``attrs``, ``outputs`` (numpy reference);
+``check_output`` builds a one-op program and compares against the numpy
+oracle; ``check_grad`` compares the registered grad lowering against central
+finite differences (reference get_numeric_gradient, op_test.py:46).
+An op is "done" when its OpTest passes on the XLA backend.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.types import canonical_dtype
+
+
+class OpTest:
+    op_type: str = None
+    inputs: dict = {}
+    outputs: dict = {}
+    attrs: dict = {}
+
+    def setup(self):
+        """Subclasses populate op_type/inputs/attrs/outputs here."""
+        raise NotImplementedError
+
+    # -- helpers ---------------------------------------------------------
+    def _as_items(self, spec):
+        """{'X': arr} or {'X': [('x0', arr), ...]} -> [(slot, var, arr)]."""
+        items = []
+        for slot, v in spec.items():
+            if isinstance(v, list) and v and isinstance(v[0], tuple):
+                for name, arr in v:
+                    items.append((slot, name, np.asarray(arr)))
+            else:
+                items.append((slot, slot.lower() + "_var", np.asarray(v)))
+        return items
+
+    def _build(self, extra_fetch_grads=()):
+        self.setup()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block
+            in_map, feeds = {}, {}
+            for slot, name, arr in self._as_items(self.inputs):
+                v = block.create_var(name=name, shape=arr.shape,
+                                     dtype=canonical_dtype(arr.dtype),
+                                     is_data=True,
+                                     stop_gradient=False)
+                in_map.setdefault(slot, []).append(v)
+                feeds[name] = arr
+            out_map, out_names = {}, {}
+            for slot, name, arr in self._as_items(self.outputs):
+                v = block.create_var(name=name, shape=arr.shape,
+                                     dtype=canonical_dtype(arr.dtype))
+                out_map.setdefault(slot, []).append(v)
+                out_names.setdefault(slot, []).append(name)
+            block.append_op(self.op_type, inputs=in_map, outputs=out_map,
+                            attrs=dict(self.attrs))
+        return main, startup, feeds, out_names
+
+    # -- checks ----------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check=(), place=None):
+        main, startup, feeds, out_names = self._build()
+        exe = fluid.Executor(place or fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            fetch = [n for slot, names in out_names.items()
+                     for n in names if slot not in no_check]
+            got = exe.run(main, feed=feeds, fetch_list=fetch)
+        expect_items = [(slot, name, arr)
+                        for slot, name, arr in self._as_items(self.outputs)
+                        if slot not in no_check]
+        for (slot, name, want), have in zip(expect_items, got):
+            np.testing.assert_allclose(
+                have, want, atol=atol, rtol=rtol,
+                err_msg=f"op {self.op_type} output {slot}/{name} mismatch")
+
+    def check_grad(self, inputs_to_check, output_name, delta=0.005,
+                   max_relative_error=0.005, place=None):
+        """Analytic grads (registry lowering under vjp) vs central finite
+        differences of loss = mean(output)."""
+        self.setup()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block
+            in_map, feeds, name_of = {}, {}, {}
+            for slot, name, arr in self._as_items(self.inputs):
+                arr = np.asarray(arr)
+                v = block.create_var(name=name, shape=arr.shape,
+                                     dtype=canonical_dtype(arr.dtype),
+                                     is_data=True, stop_gradient=False)
+                in_map.setdefault(slot, []).append(v)
+                feeds[name] = arr
+                name_of[slot] = name
+            out_map = {}
+            out_var = None
+            for slot, name, arr in self._as_items(self.outputs):
+                v = block.create_var(name=name, shape=np.asarray(arr).shape,
+                                     dtype=canonical_dtype(
+                                         np.asarray(arr).dtype))
+                out_map.setdefault(slot, []).append(v)
+                if slot == output_name or name == output_name:
+                    out_var = v
+            block.append_op(self.op_type, inputs=in_map, outputs=out_map,
+                            attrs=dict(self.attrs))
+            assert out_var is not None, f"output {output_name} not found"
+            loss = fluid.layers.mean(out_var)
+            grads = fluid.gradients(
+                [loss], [block.var(name_of[s]) if s in name_of else
+                         block.var(s) for s in inputs_to_check])
+
+        exe = fluid.Executor(place or fluid.CPUPlace())
+        scope = fluid.Scope()
+        sample_rng = np.random.RandomState(1234)
+        max_samples = 24  # sampled finite differences keep runtime bounded
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            fetch = [loss.name] + [g.name for g in grads]
+            vals = exe.run(main, feed=feeds, fetch_list=fetch)
+            analytic = dict(zip(inputs_to_check, vals[1:]))
+
+            def run_loss():
+                return float(exe.run(main, feed=feeds,
+                                     fetch_list=fetch)[0])
+
+            for slot in inputs_to_check:
+                fname = name_of.get(slot, slot)
+                base = feeds[fname].astype(np.float64)
+                flat = base.reshape(-1)
+                n = flat.size
+                idxs = (np.arange(n) if n <= max_samples else
+                        sample_rng.choice(n, max_samples, replace=False))
+                a = np.asarray(analytic[slot], np.float64).reshape(-1)
+                for i in idxs:
+                    orig = flat[i]
+                    flat[i] = orig + delta
+                    feeds[fname] = base.astype(np.float32)
+                    lp = run_loss()
+                    flat[i] = orig - delta
+                    feeds[fname] = base.astype(np.float32)
+                    lm = run_loss()
+                    flat[i] = orig
+                    feeds[fname] = base.astype(np.float32)
+                    num = (lp - lm) / (2 * delta)
+                    scale = max(abs(a[i]), abs(num), 1e-3)
+                    rel = abs(a[i] - num) / scale
+                    assert rel <= max_relative_error, (
+                        f"op {self.op_type} grad wrt {slot}[{i}]: rel err "
+                        f"{rel:.5f} (analytic {a[i]:.6f} vs numeric {num:.6f})")

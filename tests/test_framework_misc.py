@@ -1,0 +1,220 @@
+"""LR schedulers, io save/load, inference export, clip, regularizer, metrics.
+(reference analogues: test_learning_rate_scheduler.py, test_io_save_load*,
+test_gradient_clip.py, test_regularizer.py)"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.layers import learning_rate_scheduler as lrs
+
+
+def _run_lr(build_fn, steps):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    out = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            (v,) = exe.run(main, fetch_list=[lr])
+            out.append(float(np.asarray(v).reshape(-1)[0]))
+    return out
+
+
+def test_exponential_decay():
+    got = _run_lr(lambda: lrs.exponential_decay(0.1, 10, 0.5), 5)
+    want = [0.1 * 0.5 ** (i / 10) for i in range(5)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_piecewise_decay():
+    got = _run_lr(lambda: lrs.piecewise_decay([2, 4], [0.1, 0.01, 0.001]), 6)
+    np.testing.assert_allclose(got, [0.1, 0.1, 0.01, 0.01, 0.001, 0.001],
+                               rtol=1e-6)
+
+
+def test_noam_decay():
+    got = _run_lr(lambda: lrs.noam_decay(512, 4, learning_rate=2.0), 6)
+    want = [2.0 * 512 ** -0.5 * min((s + 1) ** -0.5, (s + 1) * 4 ** -1.5)
+            for s in range(6)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_cosine_decay():
+    got = _run_lr(lambda: lrs.cosine_decay(0.1, 2, 10), 4)
+    want = [0.5 * 0.1 * (np.cos((s // 2) * np.pi / 10) + 1) for s in range(4)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_linear_warmup():
+    got = _run_lr(lambda: lrs.linear_lr_warmup(0.1, 4, 0.0, 0.1), 6)
+    want = [0.0, 0.025, 0.05, 0.075, 0.1, 0.1]
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_lr_scheduler_drives_optimizer():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(pred)
+        lr = lrs.exponential_decay(0.1, 5, 0.5)
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xb = np.ones((2, 4), np.float32)
+        for _ in range(3):
+            exe.run(main, feed={"x": xb}, fetch_list=[loss])
+
+
+def test_save_load_roundtrip(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3], dtype="float32")
+        pred = fluid.layers.fc(x, 2, param_attr=fluid.ParamAttr(name="w"),
+                               bias_attr=fluid.ParamAttr(name="b"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    s1 = fluid.Scope()
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        fluid.io.save_params(exe, str(tmp_path / "ckpt"), main)
+        w1 = s1.numpy("w")
+
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        exe.run(startup)  # different init
+        fluid.io.load_params(exe, str(tmp_path / "ckpt"), main)
+        np.testing.assert_array_equal(s2.numpy("w"), w1)
+
+
+def test_save_load_shape_mismatch_error(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fluid.layers.data("x", shape=[3], dtype="float32")
+        b = main.global_block.create_parameter("p", [4], "float32")
+        startup.global_block.create_parameter("p", [4], "float32")
+        startup.global_block.append_op(
+            "fill_constant", outputs={"Out": "p"},
+            attrs={"shape": [4], "dtype": "float32", "value": 1.0})
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        fluid.io.save_params(exe, str(tmp_path / "c"), main)
+    # build a program with different shape for p
+    main2 = fluid.Program()
+    p2 = main2.global_block.create_parameter("p", [5], "float32")
+    with pytest.raises(RuntimeError, match="shape mismatch"):
+        fluid.io.load_params(exe, str(tmp_path / "c"), main2, scope=fluid.Scope())
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xb = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # one train step mutates w, THEN export
+        exe.run(main, feed={"x": xb, "y": np.zeros((4, 1), np.float32)},
+                fetch_list=[])
+        fluid.io.save_inference_model(str(tmp_path / "m"), ["x"], [pred], exe,
+                                      main)
+        # numpy oracle from the saved params
+        w = scope.numpy("w")
+        bias_name = [p.name for p in main.all_parameters()
+                     if p.name != "w"][0]
+        want = xb @ w + scope.numpy(bias_name)
+    # fresh scope + program from disk
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        prog, feeds, fetches = fluid.io.load_inference_model(
+            str(tmp_path / "m"), exe)
+        assert feeds == ["x"]
+        # pruned program must not contain optimizer ops
+        assert not any(op.type == "sgd" for op in prog.global_block.ops)
+        got = exe.run(prog, feed={"x": xb}, fetch_list=fetches)[0]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_gradient_clip_global_norm():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        pred = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"),
+                               bias_attr=False)
+        loss = fluid.layers.mean(pred)
+        fluid.set_gradient_clip(fluid.GradientClipByGlobalNorm(1e-3))
+        try:
+            opt = fluid.optimizer.SGD(1.0)
+            opt.minimize(loss)
+        finally:
+            fluid.set_gradient_clip(None)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w0 = scope.numpy("w").copy()
+        xb = np.full((2, 4), 100.0, np.float32)
+        exe.run(main, feed={"x": xb}, fetch_list=[loss])
+        w1 = scope.numpy("w")
+    # update magnitude bounded by lr * clip_norm
+    assert np.abs(w1 - w0).max() <= 1e-3 + 1e-7
+
+
+def test_l2_regularizer_changes_grad():
+    def build(reg):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[2], dtype="float32")
+            pred = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"),
+                                   bias_attr=False)
+            loss = fluid.layers.mean(pred)
+            fluid.optimizer.SGD(1.0, regularization=reg).minimize(loss)
+        return main, startup
+
+    def final_w(reg):
+        main, startup = build(reg)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            import jax.numpy as jnp
+
+            scope.set_var("w", jnp.ones((2, 1), jnp.float32))
+            exe.run(main, feed={"x": np.zeros((1, 2), np.float32)},
+                    fetch_list=[])
+            return scope.numpy("w")
+
+    w_plain = final_w(None)
+    w_reg = final_w(fluid.regularizer.L2Decay(0.1))
+    # with zero input, grad=0; L2 adds 0.1*w -> w_new = w - 0.1*w = 0.9
+    np.testing.assert_allclose(w_plain, 1.0, atol=1e-6)
+    np.testing.assert_allclose(w_reg, 0.9, atol=1e-6)
+
+
+def test_metrics_accuracy_auc():
+    m = fluid.metrics.Accuracy()
+    m.update(0.75, 4)
+    m.update(0.5, 4)
+    assert abs(m.eval() - 0.625) < 1e-9
+
+    auc = fluid.metrics.Auc()
+    preds = np.array([[0.9, 0.1], [0.2, 0.8], [0.3, 0.7], [0.6, 0.4]])
+    labels = np.array([0, 1, 1, 0])
+    auc.update(preds, labels)
+    assert auc.eval() > 0.9

@@ -1,0 +1,243 @@
+"""OpTest coverage: activations, elementwise, reductions, softmax, scale.
+(reference analogues: test_activation_op.py, test_elementwise_*_op.py,
+test_reduce_op.py, test_softmax_op.py)"""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+def _x(shape, lo=-1.0, hi=1.0, seed=42):
+    """Deterministic per-call data: a fresh RandomState each time so test
+    outcomes don't depend on pytest execution order."""
+    rng = np.random.RandomState(seed + int(np.prod(shape)) % 1000)
+    return rng.uniform(lo, hi, shape).astype(np.float32)
+
+
+class _UnaryOp(OpTest):
+    shape = (4, 17)
+    lo, hi = -1.0, 1.0
+
+    def setup(self):
+        x = _x(self.shape, self.lo, self.hi)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": self.ref(x.astype(np.float64)).astype(np.float32)}
+
+
+UNARY_CASES = [
+    ("relu", lambda x: np.maximum(x, 0), (-1, 1)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), (-3, 3)),
+    ("tanh", np.tanh, (-3, 3)),
+    ("exp", np.exp, (-2, 2)),
+    ("log", np.log, (0.1, 3)),
+    ("sqrt", np.sqrt, (0.1, 4)),
+    ("square", np.square, (-2, 2)),
+    ("abs", np.abs, (-2, 2)),
+    ("softplus", lambda x: np.log1p(np.exp(x)), (-3, 3)),
+    ("reciprocal", lambda x: 1 / x, (0.5, 3)),
+    ("sin", np.sin, (-3, 3)),
+    ("cos", np.cos, (-3, 3)),
+]
+
+
+@pytest.mark.parametrize("op,ref,rng", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary_output_and_grad(op, ref, rng):
+    class T(_UnaryOp):
+        op_type = op
+        lo, hi = rng
+        shape = (3, 9)
+
+        def ref(self, x):
+            return ref(x)
+
+    t = T()
+    t.check_output(atol=1e-5, rtol=1e-4)
+    t.check_grad(["X"], "Out", max_relative_error=5e-3)
+
+
+ELEMENTWISE_CASES = [
+    ("elementwise_add", np.add),
+    ("elementwise_sub", np.subtract),
+    ("elementwise_mul", np.multiply),
+    ("elementwise_div", np.divide),
+    ("elementwise_max", np.maximum),
+    ("elementwise_min", np.minimum),
+]
+
+
+@pytest.mark.parametrize("op,ref", ELEMENTWISE_CASES,
+                         ids=[c[0] for c in ELEMENTWISE_CASES])
+def test_elementwise_same_shape(op, ref):
+    class T(OpTest):
+        op_type = op
+
+        def setup(self):
+            x = _x((3, 7), 0.5, 2.0, seed=1)
+            y = _x((3, 7), 0.5, 2.0, seed=2)
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": ref(x, y)}
+
+    t = T()
+    t.check_output()
+    t.check_grad(["X", "Y"], "Out", max_relative_error=6e-3)
+
+
+def test_elementwise_add_axis_broadcast():
+    """Paddle broadcast rule: Y [7] spans X [3,7,2] dims starting at axis 1."""
+    class T(OpTest):
+        op_type = "elementwise_add"
+
+        def setup(self):
+            x = _x((3, 7, 2), seed=1)
+            y = _x((7,), seed=2)
+            self.inputs = {"X": x, "Y": y}
+            self.attrs = {"axis": 1}
+            self.outputs = {"Out": x + y.reshape(1, 7, 1)}
+
+    T().check_output()
+    T().check_grad(["X", "Y"], "Out")
+
+
+def test_scale():
+    class T(OpTest):
+        op_type = "scale"
+
+        def setup(self):
+            x = _x((4, 5))
+            self.inputs = {"X": x}
+            self.attrs = {"scale": 2.5, "bias": 0.7}
+            self.outputs = {"Out": x * 2.5 + 0.7}
+
+    T().check_output()
+    T().check_grad(["X"], "Out")
+
+
+def test_sum_op_multi_input():
+    class T(OpTest):
+        op_type = "sum"
+
+        def setup(self):
+            xs = [("a", _x((3, 4), seed=1)), ("b", _x((3, 4), seed=2)), ("c", _x((3, 4), seed=3))]
+            self.inputs = {"X": xs}
+            self.outputs = {"Out": xs[0][1] + xs[1][1] + xs[2][1]}
+
+    T().check_output()
+
+
+@pytest.mark.parametrize("op,ref", [
+    ("reduce_sum", np.sum), ("reduce_mean", np.mean),
+    ("reduce_max", np.max), ("reduce_min", np.min),
+])
+def test_reduce(op, ref):
+    class T(OpTest):
+        op_type = op
+
+        def setup(self):
+            x = _x((3, 5, 4))
+            self.inputs = {"X": x}
+            self.attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+            self.outputs = {"Out": ref(x, axis=1)}
+
+    T().check_output(atol=1e-5, rtol=1e-4)
+
+
+def test_reduce_all_flag():
+    class T(OpTest):
+        op_type = "reduce_sum"
+
+        def setup(self):
+            x = _x((3, 5))
+            self.inputs = {"X": x}
+            self.attrs = {"dim": [0], "keep_dim": False, "reduce_all": True}
+            self.outputs = {"Out": np.sum(x)}
+
+    T().check_output(atol=1e-5, rtol=1e-4)
+    T().check_grad(["X"], "Out")
+
+
+def test_softmax():
+    class T(OpTest):
+        op_type = "softmax"
+
+        def setup(self):
+            x = _x((5, 11))
+            e = np.exp(x - x.max(-1, keepdims=True))
+            self.inputs = {"X": x}
+            self.attrs = {"axis": -1}
+            self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+
+    T().check_output()
+    T().check_grad(["X"], "Out")
+
+
+def test_cast():
+    class T(OpTest):
+        op_type = "cast"
+
+        def setup(self):
+            x = _x((4, 4))
+            self.inputs = {"X": x}
+            self.attrs = {"in_dtype": "float32", "out_dtype": "float64"}
+            self.outputs = {"Out": x.astype(np.float64)}
+
+    T().check_output()
+
+
+def test_clip():
+    class T(OpTest):
+        op_type = "clip"
+
+        def setup(self):
+            x = _x((4, 6), -2, 2)
+            # keep away from the kink for the numeric grad
+            x[np.abs(np.abs(x) - 1.0) < 0.05] = 0.0
+            self.inputs = {"X": x}
+            self.attrs = {"min": -1.0, "max": 1.0}
+            self.outputs = {"Out": np.clip(x, -1, 1)}
+
+    T().check_output()
+    T().check_grad(["X"], "Out")
+
+
+def test_matmul_transpose():
+    class T(OpTest):
+        op_type = "matmul"
+
+        def setup(self):
+            x = _x((4, 6))
+            y = _x((5, 6))
+            self.inputs = {"X": x, "Y": y}
+            self.attrs = {"transpose_X": False, "transpose_Y": True,
+                          "alpha": 1.0}
+            self.outputs = {"Out": x @ y.T}
+
+    T().check_output(atol=1e-5, rtol=1e-4)
+    T().check_grad(["X", "Y"], "Out")
+
+
+def test_matmul_batched():
+    class T(OpTest):
+        op_type = "matmul"
+
+        def setup(self):
+            x = _x((2, 3, 4, 6), seed=1)
+            y = _x((2, 3, 6, 5), seed=2)
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": x @ y}
+
+    T().check_output(atol=1e-5, rtol=1e-4)
+
+
+def test_mul_flatten():
+    class T(OpTest):
+        op_type = "mul"
+
+        def setup(self):
+            x = _x((2, 3, 4))
+            y = _x((12, 5))
+            self.inputs = {"X": x, "Y": y}
+            self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+            self.outputs = {"Out": (x.reshape(2, 12) @ y).reshape(2, 5)}
+
+    T().check_output(atol=1e-5, rtol=1e-4)
+    T().check_grad(["X", "Y"], "Out")

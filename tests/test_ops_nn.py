@@ -1,0 +1,343 @@
+"""OpTest coverage: conv/pool/norm/dropout/losses/embedding/topk.
+(reference analogues: test_conv2d_op.py, test_pool2d_op.py,
+test_batch_norm_op.py, test_layer_norm_op.py, test_softmax_with_cross_entropy_op.py,
+test_lookup_table_op.py, test_top_k_op.py)"""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+RNG = np.random.RandomState(7)  # only for label/index generation
+
+
+def _x(shape, lo=-1.0, hi=1.0, seed=7):
+    rng = np.random.RandomState(seed + int(np.prod(shape)) % 1000)
+    return rng.uniform(lo, hi, shape).astype(np.float32)
+
+
+def _ref_conv2d(x, w, stride, pad):
+    n, c, h, ww = x.shape
+    oc, ic, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (ww + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, oc, oh, ow), np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]  # n,c,kh,kw
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out.astype(np.float32)
+
+
+def test_conv2d():
+    class T(OpTest):
+        op_type = "conv2d"
+
+        def setup(self):
+            x = _x((2, 3, 8, 8))
+            w = _x((4, 3, 3, 3))
+            self.inputs = {"Input": x, "Filter": w}
+            self.attrs = {"strides": [2, 2], "paddings": [1, 1],
+                          "dilations": [1, 1], "groups": 1}
+            self.outputs = {"Output": _ref_conv2d(x, w, 2, 1)}
+
+    T().check_output(atol=1e-4, rtol=1e-3)
+    T().check_grad(["Input", "Filter"], "Output", max_relative_error=1e-2)
+
+
+def test_pool2d_max():
+    class T(OpTest):
+        op_type = "pool2d"
+
+        def setup(self):
+            x = _x((2, 3, 6, 6))
+            ref = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+            self.inputs = {"X": x}
+            self.attrs = {"pooling_type": "max", "ksize": [2, 2],
+                          "strides": [2, 2], "paddings": [0, 0]}
+            self.outputs = {"Out": ref}
+
+    T().check_output()
+
+
+def test_pool2d_avg_global():
+    class T(OpTest):
+        op_type = "pool2d"
+
+        def setup(self):
+            x = _x((2, 5, 7, 7))
+            self.inputs = {"X": x}
+            self.attrs = {"pooling_type": "avg", "ksize": [1, 1],
+                          "strides": [1, 1], "paddings": [0, 0],
+                          "global_pooling": True}
+            self.outputs = {"Out": x.mean(axis=(2, 3), keepdims=True)}
+
+    T().check_output(atol=1e-5, rtol=1e-4)
+    T().check_grad(["X"], "Out")
+
+
+def test_batch_norm_training():
+    class T(OpTest):
+        op_type = "batch_norm"
+
+        def setup(self):
+            x = _x((4, 3, 5, 5))
+            scale, bias = _x((3,), 0.5, 1.5, seed=1), _x((3,), seed=2)
+            mean, var = np.zeros(3, np.float32), np.ones(3, np.float32)
+            mom, eps = 0.9, 1e-5
+            mu = x.mean(axis=(0, 2, 3))
+            v = x.var(axis=(0, 2, 3))
+            y = ((x - mu.reshape(1, 3, 1, 1)) /
+                 np.sqrt(v.reshape(1, 3, 1, 1) + eps)
+                 ) * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+            self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                           "Mean": mean, "Variance": var}
+            self.attrs = {"momentum": mom, "epsilon": eps, "is_test": False}
+            self.outputs = {
+                "Y": y,
+                "MeanOut": mean * mom + mu * (1 - mom),
+                "VarianceOut": var * mom + v * (1 - mom),
+                "SavedMean": mu,
+                "SavedVariance": 1.0 / np.sqrt(v + eps),
+            }
+
+    T().check_output(atol=1e-4, rtol=1e-3)
+
+
+def test_layer_norm():
+    class T(OpTest):
+        op_type = "layer_norm"
+
+        def setup(self):
+            x = _x((4, 10))
+            scale, bias = _x((10,), 0.5, 1.5, seed=1), _x((10,), seed=2)
+            eps = 1e-5
+            mu = x.mean(-1, keepdims=True)
+            v = x.var(-1, keepdims=True)
+            y = (x - mu) / np.sqrt(v + eps) * scale + bias
+            self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+            self.attrs = {"epsilon": eps, "begin_norm_axis": 1}
+            self.outputs = {"Y": y, "Mean": mu.reshape(4),
+                            "Variance": v.reshape(4)}
+
+    T().check_output(atol=1e-4, rtol=1e-3)
+    T().check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=1e-2)
+
+
+def test_softmax_with_cross_entropy():
+    class T(OpTest):
+        op_type = "softmax_with_cross_entropy"
+
+        def setup(self):
+            logits = _x((6, 10), -2, 2)
+            label = RNG.randint(0, 10, (6, 1)).astype(np.int64)
+            e = np.exp(logits - logits.max(-1, keepdims=True))
+            sm = e / e.sum(-1, keepdims=True)
+            loss = -np.log(np.take_along_axis(sm, label, axis=1) + 1e-20)
+            self.inputs = {"Logits": logits, "Label": label}
+            self.attrs = {"soft_label": False, "ignore_index": -100,
+                          "axis": -1}
+            self.outputs = {"Softmax": sm, "Loss": loss}
+
+    T().check_output(atol=1e-5, rtol=1e-4)
+
+
+def test_softmax_with_cross_entropy_soft_label():
+    class T(OpTest):
+        op_type = "softmax_with_cross_entropy"
+
+        def setup(self):
+            logits = _x((5, 7), -2, 2)
+            lbl = RNG.uniform(0, 1, (5, 7)).astype(np.float32)
+            lbl /= lbl.sum(-1, keepdims=True)
+            e = np.exp(logits - logits.max(-1, keepdims=True))
+            sm = e / e.sum(-1, keepdims=True)
+            loss = -(lbl * np.log(sm)).sum(-1, keepdims=True)
+            self.inputs = {"Logits": logits, "Label": lbl}
+            self.attrs = {"soft_label": True, "axis": -1}
+            self.outputs = {"Softmax": sm, "Loss": loss}
+
+    T().check_output(atol=1e-5, rtol=1e-4)
+
+
+def test_cross_entropy_grad():
+    class T(OpTest):
+        op_type = "cross_entropy"
+
+        def setup(self):
+            x = RNG.uniform(0.1, 1.0, (5, 4)).astype(np.float32)
+            x /= x.sum(-1, keepdims=True)
+            label = RNG.randint(0, 4, (5, 1)).astype(np.int64)
+            self.inputs = {"X": x, "Label": label}
+            self.outputs = {"Y": -np.log(
+                np.take_along_axis(x, label, axis=1) + 1e-12)}
+
+    T().check_output(atol=1e-5, rtol=1e-4)
+    T().check_grad(["X"], "Y", max_relative_error=1e-2)
+
+
+def test_lookup_table():
+    class T(OpTest):
+        op_type = "lookup_table"
+
+        def setup(self):
+            w = _x((10, 6))
+            ids = RNG.randint(0, 10, (4, 1)).astype(np.int64)
+            self.inputs = {"W": w, "Ids": ids}
+            self.outputs = {"Out": w[ids.reshape(-1)]}
+
+    T().check_output()
+    T().check_grad(["W"], "Out")
+
+
+def test_lookup_table_padding_idx():
+    class T(OpTest):
+        op_type = "lookup_table"
+
+        def setup(self):
+            w = _x((10, 6))
+            ids = np.array([[1], [3], [3], [5]], np.int64)
+            ref = w[ids.reshape(-1)].copy()
+            ref[ids.reshape(-1) == 3] = 0.0
+            self.inputs = {"W": w, "Ids": ids}
+            self.attrs = {"padding_idx": 3}
+            self.outputs = {"Out": ref}
+
+    T().check_output()
+
+
+def test_top_k():
+    class T(OpTest):
+        op_type = "top_k"
+
+        def setup(self):
+            x = _x((4, 9))
+            k = 3
+            idx = np.argsort(-x, axis=1)[:, :k]
+            self.inputs = {"X": x}
+            self.attrs = {"k": k}
+            self.outputs = {"Out": np.take_along_axis(x, idx, axis=1),
+                            "Indices": idx.astype(np.int64)}
+
+    T().check_output()
+
+
+def test_dropout_test_mode():
+    class T(OpTest):
+        op_type = "dropout"
+
+        def setup(self):
+            x = _x((4, 8))
+            self.inputs = {"X": x}
+            self.attrs = {"dropout_prob": 0.3, "is_test": True,
+                          "dropout_implementation": "downgrade_in_infer"}
+            self.outputs = {"Out": x * 0.7, "Mask": np.ones_like(x)}
+
+    T().check_output()
+
+
+def test_dropout_train_statistics():
+    """Train mode is random: check mask statistics + scaling contract."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1000], dtype="float32")
+        out = fluid.layers.dropout(x, 0.4,
+                                   dropout_implementation="upscale_in_train")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xv = np.ones((8, 1000), np.float32)
+        (o,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    kept = (o != 0)
+    assert abs(kept.mean() - 0.6) < 0.03
+    np.testing.assert_allclose(o[kept], 1.0 / 0.6, rtol=1e-5)
+
+
+def test_one_hot():
+    class T(OpTest):
+        op_type = "one_hot"
+
+        def setup(self):
+            ids = RNG.randint(0, 6, (5, 1)).astype(np.int64)
+            ref = np.zeros((5, 6), np.float32)
+            ref[np.arange(5), ids.reshape(-1)] = 1.0
+            self.inputs = {"X": ids}
+            self.attrs = {"depth": 6, "dtype": "float32"}
+            self.outputs = {"Out": ref}
+
+    T().check_output()
+
+
+def test_concat_and_grad():
+    class T(OpTest):
+        op_type = "concat"
+
+        def setup(self):
+            a, b = _x((3, 4)), _x((3, 2))
+            self.inputs = {"X": [("ca", a), ("cb", b)]}
+            self.attrs = {"axis": 1}
+            self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+
+    T().check_output()
+
+
+def test_transpose():
+    class T(OpTest):
+        op_type = "transpose2"
+
+        def setup(self):
+            x = _x((2, 3, 4))
+            self.inputs = {"X": x}
+            self.attrs = {"axis": [0, 2, 1]}
+            self.outputs = {"Out": x.transpose(0, 2, 1),
+                            "XShape": np.zeros((0,), np.float32)}
+
+    T().check_output(no_check=("XShape",))
+    T().check_grad(["X"], "Out")
+
+
+def test_reshape():
+    class T(OpTest):
+        op_type = "reshape2"
+
+        def setup(self):
+            x = _x((2, 3, 4))
+            self.inputs = {"X": x}
+            self.attrs = {"shape": [2, 12]}
+            self.outputs = {"Out": x.reshape(2, 12),
+                            "XShape": np.zeros((0,), np.float32)}
+
+    T().check_output(no_check=("XShape",))
+
+
+def test_slice():
+    class T(OpTest):
+        op_type = "slice"
+
+        def setup(self):
+            x = _x((4, 6, 5))
+            self.inputs = {"Input": x}
+            self.attrs = {"axes": [1, 2], "starts": [1, 0],
+                          "ends": [4, 3], "decrease_axis": []}
+            self.outputs = {"Out": x[:, 1:4, 0:3]}
+
+    T().check_output()
+    T().check_grad(["Input"], "Out")
+
+
+def test_gather_grad():
+    class T(OpTest):
+        op_type = "gather"
+
+        def setup(self):
+            x = _x((8, 4))
+            idx = np.array([1, 3, 3, 6], np.int64)
+            self.inputs = {"X": x, "Index": idx}
+            self.outputs = {"Out": x[idx]}
+
+    T().check_output()
+    T().check_grad(["X"], "Out")
