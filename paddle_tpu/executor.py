@@ -272,7 +272,11 @@ class Executor:
         return value
 
     def _program_fingerprint(self, program: Program) -> tuple:
+        # _version counts op appends AND Operator.set_attr mutations, so
+        # flipping e.g. is_test on a cached program recompiles (the reference
+        # invalidates via desc version)
         return (id(program), program._uid_counter,
+                getattr(program, "_version", 0),
                 sum(len(b.ops) for b in program.blocks))
 
     def _get_compiled(self, program, feed, fetch_names, scope,

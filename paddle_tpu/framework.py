@@ -249,6 +249,16 @@ class Operator:
     def attr(self, name: str):
         return self.attrs.get(name)
 
+    def set_attr(self, name: str, value) -> None:
+        """Mutate an attr AND invalidate compiled-executable caches. Direct
+        ``op.attrs[k] = v`` writes on an already-run program are NOT seen by
+        the executor cache (reference invalidates via desc version); all
+        framework code mutates through here."""
+        self.attrs[name] = value
+        self.block.program._bump_version()
+
+    _set_attr = set_attr  # reference-API alias (Operator._set_attr)
+
     def infer_shape(self):
         if registry.has_op(self.type):
             opdef = registry.get_op_def(self.type)
@@ -397,10 +407,16 @@ class Program:
         # name -> lr-scheduler / misc program-level state
         self._lr_schedulers = []
         self.random_seed = 0
+        # bumped on structural/attr mutation; part of the executor cache key
+        self._version = 0
 
     def _next_uid(self) -> int:
         self._uid_counter += 1
+        self._version += 1
         return self._uid_counter
+
+    def _bump_version(self) -> None:
+        self._version += 1
 
     # -- blocks ----------------------------------------------------------
     @property
@@ -433,9 +449,9 @@ class Program:
             for blk in p.blocks:
                 for op in blk.ops:
                     if "is_test" in op.attrs:
-                        op.attrs["is_test"] = True
+                        op.set_attr("is_test", True)
                     if op.type == "batch_norm":
-                        op.attrs["use_global_stats"] = True
+                        op.set_attr("use_global_stats", True)
         return p
 
     def list_vars(self):

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import json
 import os
-import pickle
 from typing import List, Optional
 
 import numpy as np
@@ -48,8 +47,11 @@ def _save_var_list(executor, dirname: str, vars_: List[Variable],
             np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"),
                     arr, allow_pickle=False)
     else:
+        # combined blob is an npz archive (plain tensor bytes, never pickled
+        # objects — loading an untrusted checkpoint must not execute code).
+        # Passed as a file object so np.savez keeps the exact filename.
         with open(os.path.join(dirname, filename), "wb") as f:
-            pickle.dump(blobs, f, protocol=4)
+            np.savez(f, **{n.replace("/", "__"): a for n, a in blobs.items()})
     with open(os.path.join(dirname, _MANIFEST), "w") as f:
         json.dump({"vars": manifest, "filename": filename}, f)
 
@@ -60,17 +62,21 @@ def _load_var_list(executor, dirname: str, vars_: List[Variable],
 
     scope = scope or global_scope()
     manifest_path = os.path.join(dirname, _MANIFEST)
-    combined = None
+    blobs = {}
     if filename is not None or (os.path.exists(manifest_path) and
                                 json.load(open(manifest_path)).get("filename")):
         fname = filename or json.load(open(manifest_path))["filename"]
-        with open(os.path.join(dirname, fname), "rb") as f:
-            combined = pickle.load(f)
+        with np.load(os.path.join(dirname, fname),
+                     allow_pickle=False) as combined:
+            wanted = {v.name.replace("/", "__"): v.name for v in vars_}
+            for key, name in wanted.items():
+                if key not in combined:
+                    raise RuntimeError(
+                        f"load: '{name}' missing from checkpoint")
+                blobs[name] = combined[key]
     for v in vars_:
-        if combined is not None:
-            if v.name not in combined:
-                raise RuntimeError(f"load: '{v.name}' missing from checkpoint")
-            arr = combined[v.name]
+        if blobs:
+            arr = blobs[v.name]
         else:
             path = os.path.join(dirname, v.name.replace("/", "__") + ".npy")
             if not os.path.exists(path):
@@ -195,14 +201,14 @@ def load_inference_model(dirname, executor, model_filename=None,
 # convenience full-checkpoint helpers (beyond the reference: adds step/meta)
 def save_checkpoint(executor, dirname, main_program=None, scope=None,
                     meta: dict = None):
-    save_persistables(executor, dirname, main_program, filename="ckpt.pkl",
+    save_persistables(executor, dirname, main_program, filename="ckpt.npz",
                       scope=scope)
     with open(os.path.join(dirname, "meta.json"), "w") as f:
         json.dump(meta or {}, f)
 
 
 def load_checkpoint(executor, dirname, main_program=None, scope=None) -> dict:
-    load_persistables(executor, dirname, main_program, filename="ckpt.pkl",
+    load_persistables(executor, dirname, main_program, filename="ckpt.npz",
                       scope=scope)
     meta_path = os.path.join(dirname, "meta.json")
     return json.load(open(meta_path)) if os.path.exists(meta_path) else {}

@@ -113,25 +113,63 @@ def _pool2d(ctx, ins, attrs):
     ksize = list(attrs["ksize"])
     strides = list(attrs["strides"])
     pads = list(attrs["paddings"])
-    if attrs.get("global_pooling") or attrs.get("adaptive") and ksize == [1, 1]:
-        ksize = list(xv.shape[2:])
-        strides = ksize
+    in_hw = list(xv.shape[2:])
+    if attrs.get("global_pooling") or (attrs.get("adaptive")
+                                       and ksize == [1, 1]):
+        ksize = in_hw
+        strides = list(ksize)
         pads = [0, 0]
+    elif attrs.get("adaptive"):
+        if all(d % o == 0 for d, o in zip(in_hw, ksize)):
+            # uniform regions: adaptive == fixed-window pool (window = D/o)
+            strides = [d // o for d, o in zip(in_hw, ksize)]
+            ksize, pads = list(strides), [0, 0]
+        else:
+            return out(_adaptive_pool2d(xv, ksize, attrs["pooling_type"]))
+    # ceil_mode adds right/bottom padding so the last partial window counts
+    # (reference pooling.cc output size ceil((in - k + 2p)/s) + 1)
+    extra = [0, 0]
+    if attrs.get("ceil_mode") and not attrs.get("global_pooling"):
+        for i in range(2):
+            out_ceil = -(-(in_hw[i] - ksize[i] + 2 * pads[i]) // strides[i]) + 1
+            extra[i] = max(
+                0, (out_ceil - 1) * strides[i] + ksize[i]
+                - (in_hw[i] + 2 * pads[i]))
     window = (1, 1) + tuple(ksize)
     strd = (1, 1) + tuple(strides)
-    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    padding = ((0, 0), (0, 0)) + tuple(
+        (p, p + e) for p, e in zip(pads, extra))
     if attrs["pooling_type"] == "max":
         init = -jnp.inf
         res = jax.lax.reduce_window(xv, init, jax.lax.max, window, strd, padding)
     else:
         summed = jax.lax.reduce_window(xv, 0.0, jax.lax.add, window, strd, padding)
-        if attrs.get("exclusive", True) and any(p > 0 for p in pads):
+        if attrs.get("exclusive", True) and (any(p > 0 for p in pads)
+                                             or any(e > 0 for e in extra)):
             ones = jnp.ones_like(xv)
             count = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strd, padding)
             res = summed / count
         else:
             res = summed / float(np.prod(ksize))
     return out(res)
+
+
+def _adaptive_pool2d(xv, out_hw, pooling_type):
+    """General adaptive pooling: region i spans [floor(i*D/o), ceil((i+1)*D/o)).
+    Regions are non-uniform, so reduce_window cannot express it; out_hw is a
+    static attr, so a Python loop over output cells traces to a fixed graph."""
+    in_h, in_w = xv.shape[2:]
+    oh, ow = out_hw
+    reduce_fn = jnp.max if pooling_type == "max" else jnp.mean
+    rows = []
+    for i in range(oh):
+        h0, h1 = (i * in_h) // oh, -((-(i + 1) * in_h) // oh)
+        cols = []
+        for j in range(ow):
+            w0, w1 = (j * in_w) // ow, -((-(j + 1) * in_w) // ow)
+            cols.append(reduce_fn(xv[:, :, h0:h1, w0:w1], axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
 
 
 @register_op("batch_norm",

@@ -112,6 +112,69 @@ def _adagrad(ctx, ins, attrs):
     return {"ParamOut": [p_out], "MomentOut": [m_out]}
 
 
+@register_op("decayed_adagrad", inputs=["Param", "Grad", "Moment",
+                                        "LearningRate"],
+             outputs=["ParamOut", "MomentOut"],
+             attrs={"decay": 0.95, "epsilon": 1e-6}, grad=None)
+def _decayed_adagrad(ctx, ins, attrs):
+    """reference optimizers/decayed_adagrad_op.h: decayed average of grad^2,
+    unlike adagrad's monotone accumulation."""
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    m, lr = x(ins, "Moment"), x(ins, "LearningRate").reshape(())
+    decay = attrs["decay"]
+    m_out = decay * m + (1 - decay) * g * g
+    p_out = p - lr * g / (jnp.sqrt(m_out) + attrs["epsilon"])
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register_op("average_accumulates",
+             inputs=["Param", "InSum1", "InSum2", "InSum3",
+                     "InNumAccumulates", "InOldNumAccumulates",
+                     "InNumUpdates"],
+             outputs=["OutSum1", "OutSum2", "OutSum3", "OutNumAccumulates",
+                      "OutOldNumAccumulates", "OutNumUpdates"],
+             attrs={"average_window": 0.15, "min_average_window": 10000,
+                    "max_average_window": 10000}, grad=None)
+def _average_accumulates(ctx, ins, attrs):
+    """reference operators/average_accumulates_op.h — the state machine behind
+    ModelAverage: sum_1 accumulates params each step; sum_2 archives sum_1
+    every kMaxNumAccumulates steps (float-precision guard); when the window is
+    full, everything rolls into sum_3 and counting restarts. Branches become
+    jnp.where so the whole rule stays jittable."""
+    kMaxNumAccumulates = 16384
+    p = x(ins, "Param")
+    s1, s2, s3 = x(ins, "InSum1"), x(ins, "InSum2"), x(ins, "InSum3")
+    num_acc = x(ins, "InNumAccumulates").reshape(())
+    old_num = x(ins, "InOldNumAccumulates").reshape(())
+    num_upd = x(ins, "InNumUpdates").reshape(())
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    s1 = s1 + p
+
+    # archive sum_1 into sum_2 periodically to bound fp error
+    archive = (num_upd % kMaxNumAccumulates) == 0
+    s2 = jnp.where(archive, s2 + s1, s2)
+    s1 = jnp.where(archive, jnp.zeros_like(s1), s1)
+
+    # window full -> roll into sum_3, restart counting
+    window = jnp.minimum(
+        jnp.asarray(attrs["max_average_window"], num_acc.dtype),
+        (num_upd.astype(jnp.float32)
+         * attrs["average_window"]).astype(num_acc.dtype))
+    full = (num_acc >= attrs["min_average_window"]) & (num_acc >= window)
+    s3 = jnp.where(full, s1 + s2, s3)
+    s1 = jnp.where(full, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(full, jnp.zeros_like(s2), s2)
+    old_num = jnp.where(full, num_acc, old_num)
+    num_acc = jnp.where(full, jnp.zeros_like(num_acc), num_acc)
+
+    return {"OutSum1": [s1], "OutSum2": [s2], "OutSum3": [s3],
+            "OutNumAccumulates": [num_acc.reshape((1,))],
+            "OutOldNumAccumulates": [old_num.reshape((1,))],
+            "OutNumUpdates": [num_upd.reshape((1,))]}
+
+
 @register_op("adadelta", inputs=["Param", "Grad", "AvgSquaredGrad",
                                  "AvgSquaredUpdate"],
              outputs=["ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"],

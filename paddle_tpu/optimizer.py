@@ -9,6 +9,7 @@ reference's fuse_optimizer_ops/coalesce_grad_tensor passes are unnecessary.
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, List, Optional
 
 from . import unique_name
@@ -226,7 +227,30 @@ class AdagradOptimizer(Optimizer):
             attrs={"epsilon": self._epsilon})
 
 
-DecayedAdagradOptimizer = AdagradOptimizer  # decay handled via regularization
+class DecayedAdagradOptimizer(Optimizer):
+    """reference optimizer.py DecayedAdagrad: moment tracks a DECAYED average
+    of grad^2 (decayed_adagrad_op.h), not adagrad's monotone sum."""
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "decayed_adagrad"
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": p, "Grad": g, "Moment": m,
+                    "LearningRate": self._create_param_lr(param_and_grad)},
+            outputs={"ParamOut": p, "MomentOut": m},
+            attrs={"decay": self._decay, "epsilon": self._epsilon})
 
 
 class AdamOptimizer(Optimizer):
@@ -480,20 +504,24 @@ class ExponentialMovingAverage:
     def update(self):
         pass  # updates are appended into the main program at construction
 
-    import contextlib as _ctx
-
-    @_ctx.contextmanager
+    @contextlib.contextmanager
     def apply(self, executor, need_restore=True):
         from .executor import global_scope
 
         scope = global_scope()
+        # validate BEFORE mutating so a missing shadow var can't leave the
+        # scope half-swapped with no restore
+        for p in self._params:
+            if scope.find_var(self._ema_vars[p.name].name) is None:
+                raise RuntimeError(
+                    f"EMA shadow var '{self._ema_vars[p.name].name}' is not "
+                    f"in the scope — construct ExponentialMovingAverage "
+                    f"before training and run the startup+main programs that "
+                    f"contain its ops")
         saved = {}
         for p in self._params:
-            ema = self._ema_vars[p.name]
             saved[p.name] = scope.find_var(p.name)
-            v = scope.find_var(ema.name)
-            if v is not None:
-                scope.set_var(p.name, v)
+            scope.set_var(p.name, scope.find_var(self._ema_vars[p.name].name))
         try:
             yield
         finally:
@@ -506,23 +534,108 @@ class ExponentialMovingAverage:
 
 
 class ModelAverage(Optimizer):
-    """reference optimizer.py:2361 — running average of params; simplified to
-    EMA-style accumulation with uniform weights over a window."""
+    """reference optimizer.py:2361 — TRUE windowed average of params via the
+    average_accumulates op (reference average_accumulates_op.h), not EMA.
+
+    Must be constructed AFTER minimize() but BEFORE training runs: like the
+    reference, construction appends accumulation ops to the main program, so
+    the sums only exist if the accumulating program is what trains. apply()
+    raises if the accumulators never ran."""
 
     def __init__(self, average_window_rate=0.15, min_average_window=10000,
                  max_average_window=10000, regularization=None, name=None):
         super().__init__(0.0, regularization, name)
-        self._window = max_average_window
-        self._ema = None
+        self._avg_window_rate = average_window_rate
+        self._min_window = min_average_window
+        self._max_window = max_average_window
+        self._params: List[Parameter] = []
+        self._acc_names: Dict[str, Dict[str, str]] = {}
+        program = default_main_program()
+        block = program.global_block
+        startup = default_startup_program().global_block
+        for p in program.all_parameters():
+            if not p.trainable or getattr(p, "do_model_average", None) is False:
+                continue
+            self._params.append(p)
+            names = {}
+            for slot, shape, dtype in (
+                    ("sum_1", p.shape, p.dtype), ("sum_2", p.shape, p.dtype),
+                    ("sum_3", p.shape, p.dtype),
+                    ("num_accumulates", (1,), "int64"),
+                    ("old_num_accumulates", (1,), "int64"),
+                    ("num_updates", (1,), "int64")):
+                vname = unique_name.generate(f"{p.name}.{slot}")
+                names[slot] = vname
+                block.create_var(name=vname, shape=tuple(shape), dtype=dtype,
+                                 persistable=True, stop_gradient=True)
+                startup.create_var(name=vname, shape=tuple(shape), dtype=dtype,
+                                   persistable=True)
+                startup.append_op("fill_constant", outputs={"Out": vname},
+                                  attrs={"shape": list(shape), "dtype": dtype,
+                                         "value": 0.0})
+            self._acc_names[p.name] = names
+            block.append_op(
+                "average_accumulates",
+                inputs={"Param": p.name, "InSum1": names["sum_1"],
+                        "InSum2": names["sum_2"], "InSum3": names["sum_3"],
+                        "InNumAccumulates": names["num_accumulates"],
+                        "InOldNumAccumulates": names["old_num_accumulates"],
+                        "InNumUpdates": names["num_updates"]},
+                outputs={"OutSum1": names["sum_1"], "OutSum2": names["sum_2"],
+                         "OutSum3": names["sum_3"],
+                         "OutNumAccumulates": names["num_accumulates"],
+                         "OutOldNumAccumulates": names["old_num_accumulates"],
+                         "OutNumUpdates": names["num_updates"]},
+                attrs={"average_window": average_window_rate,
+                       "min_average_window": min_average_window,
+                       "max_average_window": max_average_window})
 
     def minimize(self, loss, **kw):
         raise RuntimeError("ModelAverage wraps a trained program; call apply()")
 
+    @contextlib.contextmanager
     def apply(self, executor, need_restore=True):
-        if self._ema is None:
-            self._ema = ExponentialMovingAverage(
-                decay=1.0 - 1.0 / max(self._window, 1))
-        return self._ema.apply(executor, need_restore)
+        """Swap params to (sum_1+sum_2+sum_3)/(num+old_num) in the scope."""
+        import numpy as np
+
+        from .executor import global_scope
+
+        scope = global_scope()
+        # compute every average BEFORE mutating the scope so a missing or
+        # empty accumulator can't leave params half-swapped with no restore
+        averaged = {}
+        for p in self._params:
+            names = self._acc_names[p.name]
+            s1 = scope.find_var(names["sum_1"])
+            if s1 is None:
+                raise RuntimeError(
+                    f"ModelAverage accumulator '{names['sum_1']}' is not in "
+                    f"the scope — the accumulating program never ran. "
+                    f"Construct ModelAverage before training (after "
+                    f"optimizer.minimize) and train the SAME program.")
+            s2 = scope.find_var(names["sum_2"])
+            s3 = scope.find_var(names["sum_3"])
+            n = int(np.asarray(scope.find_var(names["num_accumulates"]))[0])
+            old_n = int(np.asarray(
+                scope.find_var(names["old_num_accumulates"]))[0])
+            total = n + old_n
+            if total == 0:
+                raise RuntimeError(
+                    "ModelAverage.apply: zero accumulated steps — train "
+                    "before applying the average")
+            averaged[p.name] = (
+                np.asarray(s1) + np.asarray(s2) + np.asarray(s3)) / total
+        saved = {}
+        for p in self._params:
+            saved[p.name] = scope.find_var(p.name)
+            scope.set_var(p.name, averaged[p.name].astype(
+                np.asarray(saved[p.name]).dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                for name, v in saved.items():
+                    scope.set_var(name, v)
 
     def restore(self, executor):
         pass

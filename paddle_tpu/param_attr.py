@@ -7,7 +7,7 @@ from typing import Optional
 class ParamAttr:
     def __init__(self, name: Optional[str] = None, initializer=None,
                  learning_rate: float = 1.0, regularizer=None,
-                 trainable: bool = True, do_model_average: bool = False):
+                 trainable: bool = True, do_model_average: bool = True):
         self.name = name
         self.initializer = initializer
         self.learning_rate = learning_rate

@@ -1,0 +1,207 @@
+"""Round-2 correctness fixes: decayed_adagrad, pool2d ceil/adaptive,
+ModelAverage true windowed average, npz checkpoints, cache invalidation."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+class TestDecayedAdagradOp(OpTest):
+    def setup(self):
+        rng = np.random.RandomState(0)
+        p = rng.rand(4, 3).astype(np.float32)
+        g = rng.rand(4, 3).astype(np.float32)
+        m = rng.rand(4, 3).astype(np.float32)
+        lr = np.array([0.01], np.float32)
+        decay, eps = 0.95, 1e-6
+        m_out = decay * m + (1 - decay) * g * g
+        p_out = p - lr * g / (np.sqrt(m_out) + eps)
+        self.op_type = "decayed_adagrad"
+        self.inputs = {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr}
+        self.attrs = {"decay": decay, "epsilon": eps}
+        self.outputs = {"ParamOut": p_out, "MomentOut": m_out}
+
+    def test(self):
+        self.check_output()
+
+
+def test_decayed_adagrad_differs_from_adagrad():
+    """The decayed rule must NOT monotonically accumulate like adagrad."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32")
+        y = fluid.layers.fc(x, 1, name="da_fc")
+        loss = fluid.layers.mean(y)
+        opt = fluid.optimizer.DecayedAdagrad(learning_rate=0.1, decay=0.5)
+        opt.minimize(loss)
+    assert any(op.type == "decayed_adagrad" for op in main.global_block.ops)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed={"x": np.ones((4, 2), np.float32)},
+                    fetch_list=[loss])
+        # moment stays bounded by max grad^2 under decayed averaging
+        m_names = [n for n in scope.vars if n.startswith("moment_")]
+        assert m_names, "moment accumulator missing"
+
+
+class TestPool2dCeilMode(OpTest):
+    def setup(self):
+        # ADVICE case: 6x6 input, k3 s2 ceil -> 3x3 output (floor gives 2x2)
+        x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+        want = np.zeros((1, 1, 3, 3), np.float32)
+        for i in range(3):
+            for j in range(3):
+                want[0, 0, i, j] = x[0, 0, 2*i:2*i+3, 2*j:2*j+3].max()
+        self.op_type = "pool2d"
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [3, 3],
+                      "strides": [2, 2], "paddings": [0, 0],
+                      "ceil_mode": True}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.check_output()
+
+
+class TestPool2dCeilModeAvgExclusive(OpTest):
+    def setup(self):
+        x = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+        want = np.zeros((1, 1, 3, 3), np.float32)
+        for i in range(3):
+            for j in range(3):
+                win = x[0, 0, 2*i:min(2*i+2, 5), 2*j:min(2*j+2, 5)]
+                want[0, 0, i, j] = win.mean()  # exclusive: only real elements
+        self.op_type = "pool2d"
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0],
+                      "ceil_mode": True, "exclusive": True}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.check_output()
+
+
+class TestPool2dAdaptiveGeneral(OpTest):
+    def setup(self):
+        # 5x5 -> 2x2 adaptive avg: non-uniform regions [0:3),[2:5) per torch/
+        # paddle semantics floor(i*D/o)..ceil((i+1)*D/o)
+        x = np.random.RandomState(3).rand(2, 3, 5, 5).astype(np.float32)
+        oh = ow = 2
+        want = np.zeros((2, 3, 2, 2), np.float32)
+        for i in range(oh):
+            h0, h1 = (i * 5) // oh, -((-(i + 1) * 5) // oh)
+            for j in range(ow):
+                w0, w1 = (j * 5) // ow, -((-(j + 1) * 5) // ow)
+                want[:, :, i, j] = x[:, :, h0:h1, w0:w1].mean(axis=(2, 3))
+        self.op_type = "pool2d"
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2], "adaptive": True}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.check_output()
+
+
+class TestPool2dAdaptiveUniform(OpTest):
+    def setup(self):
+        # 6x6 -> 3x3 adaptive max: uniform fast path (2x2 windows)
+        x = np.random.RandomState(4).rand(1, 2, 6, 6).astype(np.float32)
+        want = x.reshape(1, 2, 3, 2, 3, 2).max(axis=(3, 5))
+        self.op_type = "pool2d"
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [3, 3], "adaptive": True}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.check_output()
+
+
+def _build_sgd_model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32")
+        y = fluid.layers.fc(x, 1, name="ma_fc", bias_attr=False)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_model_average_true_windowed_mean():
+    main, startup, loss = _build_sgd_model()
+    with fluid.program_guard(main, startup):
+        # window larger than the run so no roll happens: the applied value is
+        # the plain mean over all 5 steps
+        ma = fluid.optimizer.ModelAverage(
+            average_window_rate=1.0, min_average_window=100,
+            max_average_window=100)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    pname = main.all_parameters()[0].name
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        param_history = []
+        for _ in range(5):
+            exe.run(main, feed={"x": np.ones((4, 2), np.float32)},
+                    fetch_list=[loss])
+            param_history.append(scope.numpy(pname).copy())
+        final = scope.numpy(pname).copy()
+        with ma.apply(exe):
+            got = scope.numpy(pname)
+            want = np.mean(param_history, axis=0)
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+            assert not np.allclose(got, final), "average equals final weights"
+        np.testing.assert_allclose(scope.numpy(pname), final)  # restored
+
+
+def test_model_average_raises_without_training():
+    main, startup, loss = _build_sgd_model()
+    with fluid.program_guard(main, startup):
+        ma = fluid.optimizer.ModelAverage(min_average_window=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with pytest.raises(RuntimeError, match="never ran|not in"):
+            with ma.apply(exe):
+                pass
+
+
+def test_checkpoint_npz_not_pickle(tmp_path):
+    main, startup, loss = _build_sgd_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    d = str(tmp_path / "ckpt")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_checkpoint(exe, d, main, meta={"step": 7})
+        import zipfile
+        assert zipfile.is_zipfile(f"{d}/ckpt.npz"), "combined blob must be npz"
+        pname = main.all_parameters()[0].name
+        orig = scope.numpy(pname).copy()
+        scope.set_var(pname, np.zeros_like(orig))
+        meta = fluid.io.load_checkpoint(exe, d, main)
+        assert meta["step"] == 7
+        np.testing.assert_allclose(scope.numpy(pname), orig)
+
+
+def test_executor_cache_invalidated_by_set_attr():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.dropout(x, dropout_prob=0.99)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feed = {"x": np.ones((4, 8), np.float32)}
+    drop_op = next(op for op in main.global_block.ops
+                   if op.type == "dropout")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        train_out = exe.run(main, feed=feed, fetch_list=[y])[0]
+        drop_op.set_attr("is_test", True)  # must recompile, not reuse cache
+        test_out = exe.run(main, feed=feed, fetch_list=[y])[0]
+    assert np.count_nonzero(train_out) < train_out.size  # p=.99 zeroed most
+    np.testing.assert_allclose(test_out, feed["x"] * 0.01, rtol=1e-5)
