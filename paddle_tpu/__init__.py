@@ -18,6 +18,7 @@ from .framework import (Block, Operator, Parameter, Program, Variable,
                         in_dygraph_mode, name_scope, program_guard)
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .parallel import BuildStrategy, CompiledProgram, ExecutionStrategy
+from . import distributed
 from . import io
 from . import metrics
 from . import optimizer

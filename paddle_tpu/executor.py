@@ -42,7 +42,14 @@ class Place:
 
 class CPUPlace(Place):
     def jax_device(self):
-        return jax.devices("cpu")[0]
+        # local, not global: under multi-process the global list includes
+        # other trainers' devices, which are not addressable here. backend=
+        # "cpu" because plain local_devices() lists only the default backend
+        # (on a TPU host that would silently hand back the TPU).
+        try:
+            return jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            return jax.local_devices()[0]
 
 
 class TPUPlace(Place):
@@ -51,12 +58,12 @@ class TPUPlace(Place):
 
     def jax_device(self):
         try:
-            devs = [d for d in jax.devices() if d.platform != "cpu"]
+            devs = [d for d in jax.local_devices() if d.platform != "cpu"]
             if devs:
                 return devs[self.device_id % len(devs)]
         except RuntimeError:
             pass
-        return jax.devices()[0]
+        return jax.local_devices()[0]
 
 
 class CUDAPlace(TPUPlace):
@@ -274,9 +281,9 @@ class Executor:
     def _program_fingerprint(self, program: Program) -> tuple:
         # _version counts op appends AND Operator.set_attr mutations, so
         # flipping e.g. is_test on a cached program recompiles (the reference
-        # invalidates via desc version)
-        return (id(program), program._uid_counter,
-                getattr(program, "_version", 0),
+        # invalidates via desc version); op count catches op removal, which
+        # bumps no counter
+        return (id(program), getattr(program, "_version", 0),
                 sum(len(b.ops) for b in program.blocks))
 
     def _get_compiled(self, program, feed, fetch_names, scope,

@@ -63,6 +63,30 @@ def data_parallel_mesh(places=None) -> Mesh:
     return Mesh(devices, axis_names=("dp",))
 
 
+def _ensure_global(v, sharding):
+    """Promote a process-local array (e.g. fresh from the per-process startup
+    run) to a global array on the multi-process mesh. Startup programs run
+    identically on every process (same seeds), so replicated promotion is the
+    reference's BCastParamsToDevices without the broadcast."""
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        if v.sharding.is_equivalent_to(sharding, v.ndim):
+            return v  # already global with the right layout
+        raise RuntimeError(
+            f"state array has cross-process sharding {v.sharding} but the "
+            f"step expects {sharding}; cannot reshard across processes")
+    host = np.asarray(v)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
+
+
+def _fetch_numpy(v) -> np.ndarray:
+    """np.asarray for fetches that works when the array spans processes:
+    fetch out_shardings are replicated, so shard 0 holds the full value."""
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        return np.asarray(v.addressable_data(0))
+    return np.asarray(v)
+
+
 class CompiledProgram:
     def __init__(self, program: Program, build_strategy: Optional[BuildStrategy] = None):
         self._program = program
@@ -100,7 +124,19 @@ class CompiledProgram:
                        for f in (fetch_list or [])]
         program = self._program
         step = self._get_compiled(exe, program, feed, fetch_names, scope)
-        feed_vals = [jnp.asarray(np.asarray(feed[n])) for n in step.feed_names]
+        multiproc = jax.process_count() > 1
+        batch_shard = NamedSharding(self._mesh, P("dp"))
+        repl = NamedSharding(self._mesh, P())
+        if multiproc:
+            # each trainer feeds its LOCAL batch shard; together they form
+            # the global batch (the reference's FeedAndSplitTensorIntoLocal
+            # Scopes, parallel_executor.cc:75, inverted: feeds are split
+            # before the call, not inside it)
+            feed_vals = [jax.make_array_from_process_local_data(
+                batch_shard, np.asarray(feed[n])) for n in step.feed_names]
+        else:
+            feed_vals = [jnp.asarray(np.asarray(feed[n]))
+                         for n in step.feed_names]
 
         def read(names):
             vals = []
@@ -108,6 +144,8 @@ class CompiledProgram:
                 v = scope.find_var(n)
                 if v is None:
                     raise RuntimeError(f"Variable '{n}' not initialized in scope")
+                if multiproc:
+                    v = _ensure_global(v, repl)
                 vals.append(v)
             return vals
 
@@ -117,7 +155,7 @@ class CompiledProgram:
         for n, v in zip(step.state_out_names, new_state):
             scope.set_var(n, v)
         if return_numpy:
-            return [np.asarray(v) for v in fetches]
+            return [_fetch_numpy(v) for v in fetches]
         return list(fetches)
 
     def _get_compiled(self, exe, program, feed, fetch_names, scope):
@@ -151,7 +189,14 @@ class CompiledProgram:
             [repl_spec] * len(io["ro"]),
             None,
         )
+        # fetches + state pinned replicated so multi-process fetch reads one
+        # addressable shard and state stays valid as a next-step input
+        out_shardings = (
+            [repl_spec] * len(fetch_names),
+            [repl_spec] * len(io["state_out"]),
+        )
         jitted = jax.jit(step_fn, donate_argnums=(1,),
-                         in_shardings=in_shardings)
+                         in_shardings=in_shardings,
+                         out_shardings=out_shardings)
         return _CompiledStep(jitted, io["feed_order"], io["donated"],
                              io["ro"], io["state_out"], tuple(fetch_names))

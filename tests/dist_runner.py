@@ -1,0 +1,65 @@
+"""Runnable distributed-training script (reference test_dist_base.py model
+scripts: dist_mnist.py subclassing TestDistRunnerBase:61). Trains a fixed MLP
+regression on deterministic synthetic data; under the launcher each rank
+feeds its slice of the SAME global batch, standalone feeds the full batch —
+losses must match bit-for-bit up to float tolerance. Rank 0 prints the loss
+series as one JSON line prefixed with LOSSES."""
+import json
+import os
+import sys
+
+import numpy as np
+
+GLOBAL_BATCH = 8
+STEPS = 10
+DIM = 16
+
+
+def main():
+    nranks = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+    if nranks > 1:
+        from paddle_tpu import distributed as dist
+
+        dist.init_parallel_env()
+    else:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 2)
+
+    import paddle_tpu as fluid
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data("x", shape=[DIM], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, 32, act="relu", name="d_fc1")
+        pred = fluid.layers.fc(h, 1, name="d_fc2")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.02).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    compiled = fluid.CompiledProgram(main_p).with_data_parallel(
+        loss_name=loss.name)
+
+    local = GLOBAL_BATCH // nranks
+    rng = np.random.RandomState(42)
+    w_true = np.linspace(-1, 1, DIM).astype(np.float32).reshape(DIM, 1)
+    xb = rng.rand(GLOBAL_BATCH, DIM).astype(np.float32)
+    yb = np.tanh(xb @ w_true).astype(np.float32)
+    losses = []
+    for step in range(STEPS):
+        sl = slice(rank * local, (rank + 1) * local) if nranks > 1 \
+            else slice(None)
+        lv = exe.run(compiled, feed={"x": xb[sl], "y": yb[sl]},
+                     fetch_list=[loss])[0]
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    if rank == 0:
+        print("LOSSES " + json.dumps(losses), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,62 @@
+"""Multi-process distributed correctness (reference test_dist_base.py:628
+_run_cluster + check_with_place:827): the same model trained (a) single
+process over a 2-device dp mesh and (b) 2 launcher-spawned processes x 1
+device with gloo collectives must produce matching loss curves."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUNNER = os.path.join(REPO, "tests", "dist_runner.py")
+
+
+def _clean_env():
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith("PADDLE_"):
+            del env[k]
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _parse_losses(out: str):
+    for line in out.splitlines():
+        if line.startswith("LOSSES "):
+            return json.loads(line[len("LOSSES "):])
+    raise AssertionError(f"no LOSSES line in output:\n{out}")
+
+
+def test_two_process_loss_equality():
+    env = _clean_env()
+    single = subprocess.run([sys.executable, "-u", RUNNER], env=env,
+                            capture_output=True, text=True, timeout=600)
+    assert single.returncode == 0, single.stdout + single.stderr
+    base = _parse_losses(single.stdout)
+
+    dist = subprocess.run(
+        [sys.executable, "-u", "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--backend", "cpu",
+         "--local_devices", "1", RUNNER],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert dist.returncode == 0, dist.stdout + dist.stderr
+    got = _parse_losses(dist.stdout)
+
+    assert len(base) == len(got) == 10
+    np.testing.assert_allclose(got, base, rtol=2e-4, atol=1e-5)
+    # training must actually progress
+    assert base[-1] < base[0]
+
+
+def test_launcher_propagates_failure():
+    env = _clean_env()
+    bad = os.path.join(REPO, "tests", "conftest.py")  # not a runnable trainer
+    r = subprocess.run(
+        [sys.executable, "-u", "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--backend", "cpu",
+         "/nonexistent_script.py"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode != 0
