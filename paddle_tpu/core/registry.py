@@ -62,6 +62,10 @@ class OpDef:
     # slots of the *forward* op that the auto-grad lowering does not need
     # (lets the executor drop dead buffers, cf. NoNeedBufferVarsInference)
     no_need_buffer: Sequence[str] = ()
+    # raw ops get lower(ctx, op, env) instead of lower(ctx, ins, attrs):
+    # control-flow ops need the op's var names and sub-block access
+    # (reference: while_op.cc runs a sub-block with its own Executor)
+    raw: bool = False
 
     def input_spec(self, slot: str) -> Optional[IOSpec]:
         for s in self.inputs:
@@ -86,6 +90,7 @@ def register_op(
     grad_lower: Optional[Callable] = None,
     needs_rng: bool = False,
     no_need_buffer: Sequence[str] = (),
+    raw: bool = False,
 ):
     """Decorator registering ``fn`` as the lowering rule for op ``type``.
 
@@ -122,6 +127,7 @@ def register_op(
             grad_lower=grad_lower,
             needs_rng=needs_rng,
             no_need_buffer=tuple(no_need_buffer),
+            raw=raw,
         )
         return fn
 

@@ -187,7 +187,8 @@ def make_step_fn(block, io: dict, fetch_names, mesh=None):
         env.update(zip(io["feed_order"], feed_vals))
         env.update(zip(io["donated"], donated_vals))
         env.update(zip(io["ro"], ro_vals))
-        ctx = LowerCtx(base_key=rng_key, mesh=mesh)
+        ctx = LowerCtx(base_key=rng_key, mesh=mesh,
+                       program=getattr(block, "program", None))
         lower_block(block, env, ctx)
         fetches = [env[n] for n in fetch_names]
         new_state = [env[n] for n in io["state_out"]]

@@ -7,6 +7,10 @@ from .tensor import (assign, create_global_var, create_tensor,  # noqa: F401
                      gaussian_random, linspace, ones, ones_like,
                      uniform_random, zeros, zeros_like)
 from . import nn  # noqa: F401
+from .control_flow import (While, Switch, IfElse, StaticRNN,  # noqa: F401
+                           array_length, array_read, array_write, cond,
+                           create_array, tensor_array_to_tensor)
+from . import control_flow  # noqa: F401
 from . import tensor  # noqa: F401
 from .learning_rate_scheduler import (cosine_decay, exponential_decay,  # noqa: F401
                                       inverse_time_decay, linear_lr_warmup,

@@ -35,11 +35,13 @@ EMPTY_VAR_NAME = "@EMPTY@"
 class LowerCtx:
     """Context passed to every op lowering rule."""
 
-    def __init__(self, base_key=None, uid: int = 0, mesh=None, axis_env=None):
+    def __init__(self, base_key=None, uid: int = 0, mesh=None, axis_env=None,
+                 program=None):
         self.base_key = base_key
         self.uid = uid
         self.mesh = mesh          # jax.sharding.Mesh when lowering under shard_map
         self.axis_env = axis_env  # dict of mesh axis names usable in collectives
+        self.program = program    # owning Program: sub-block lookup for while/cond
 
     def rng(self):
         """PRNG key unique to this op instance; grad ops fold in the forward
@@ -50,7 +52,8 @@ class LowerCtx:
         return jax.random.fold_in(self.base_key, self.uid)
 
     def with_uid(self, uid: int) -> "LowerCtx":
-        return LowerCtx(self.base_key, uid, self.mesh, self.axis_env)
+        return LowerCtx(self.base_key, uid, self.mesh, self.axis_env,
+                        self.program)
 
 
 def _gather_inputs(op, env: Dict[str, Any]) -> Dict[str, List[Any]]:
@@ -80,8 +83,14 @@ def lower_op(op, env: Dict[str, Any], ctx: LowerCtx) -> None:
         _lower_generic_grad(op, env, ctx)
         return
     opdef = registry.get_op_def(op.type)
-    ins = _gather_inputs(op, env)
     op_ctx = ctx.with_uid(op.attrs.get("__uid__", 0))
+    if opdef.raw:
+        # control-flow ops interpret their sub-block themselves
+        if op_ctx.program is None:
+            op_ctx.program = op.block.program
+        opdef.lower(op_ctx, op, env)
+        return
+    ins = _gather_inputs(op, env)
     outs = opdef.lower(op_ctx, ins, op.attrs)
     _write_outputs(op, outs, env)
 
@@ -127,8 +136,13 @@ def _lower_generic_grad(op, env: Dict[str, Any], ctx: LowerCtx) -> None:
     fwd_type = op.attrs["__fwd_type__"]
     fwd_def = registry.get_op_def(fwd_type)
     if fwd_def.grad_lower is not None:
-        ins = _gather_inputs(op, env)
         op_ctx = ctx.with_uid(op.attrs.get("__fwd_uid__", op.attrs.get("__uid__", 0)))
+        if fwd_def.raw:
+            if op_ctx.program is None:
+                op_ctx.program = op.block.program
+            fwd_def.grad_lower(op_ctx, op, env)
+            return
+        ins = _gather_inputs(op, env)
         outs = fwd_def.grad_lower(op_ctx, ins, op.attrs)
         _write_outputs(op, outs, env)
         return

@@ -1,0 +1,470 @@
+"""Control-flow ops: while, conditional_block, recurrent (StaticRNN), tensor
+arrays, beam search.
+
+Reference: paddle/fluid/operators/controlflow/while_op.cc (sub-block run in a
+loop over StepScopes), conditional_block_op.cc, recurrent_op.cc (static RNN
+over time steps with memory vars), tensor_array_read_write.cc,
+math/beam_search.cc.
+
+TPU-native redesign — the reference interprets sub-blocks with a nested
+Executor and dynamic StepScopes; XLA needs structured control flow:
+
+* ``while``       -> ``lax.while_loop``. Loop state = the op's Out vars (all
+                     parent-block vars the body writes). Tensor arrays in the
+                     carry become fixed-capacity buffers (see TensorArrayVal).
+                     Non-differentiable (lax.while_loop has no reverse-mode);
+                     the training-side RNN story is ``recurrent``.
+* ``conditional_block`` -> ``lax.cond`` with a zero/passthrough else-branch.
+* ``recurrent``   -> ``lax.scan`` over the time axis: memories are the carry,
+                     step inputs the xs, step outputs the stacked ys. Fully
+                     differentiable via a custom vjp grad lowering, so
+                     StaticRNN trains (reference recurrent_grad op).
+* beam_search     -> dense batched [batch*beam] top-k (the reference's
+                     LoD-based variable beams trade away; fixed beam width is
+                     the XLA-idiomatic encoding).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import IOSpec, register_op
+from ..lowering import lower_block
+from .common import out, x
+
+EMPTY = "@EMPTY@"
+
+
+# ---------------------------------------------------------------------------
+# Tensor arrays (reference LoDTensorArray + tensor_array_read_write.cc)
+# ---------------------------------------------------------------------------
+
+class TensorArrayVal:
+    """Value of a LOD_TENSOR_ARRAY var inside the lowering env.
+
+    Two modes:
+    * list mode (outside loops): ``entries`` is a Python list, indices are
+      trace-time constants — append/overwrite freely.
+    * buffer mode (loop carry): fixed ``capacity`` stacked buffer + traced
+      ``size``; writes become dynamic_update_slice. XLA requires static
+      shapes inside while bodies, so capacity is fixed when the array enters
+      a loop (While(max_len=...) or the default capacity).
+    """
+
+    def __init__(self, entries=None, buffer=None, size=None):
+        self.entries: List[Any] = entries if entries is not None else []
+        self.buffer = buffer
+        self.size = size
+
+    @property
+    def buffered(self) -> bool:
+        return self.buffer is not None
+
+    def to_buffer(self, capacity: int) -> "TensorArrayVal":
+        if self.buffered:
+            return self
+        if not self.entries:
+            raise ValueError(
+                "tensor array entering a While loop has no entries yet — "
+                "write the initial element (e.g. array_write at step 0) "
+                "before the loop so its element shape is known")
+        elem = jnp.asarray(self.entries[0])
+        buf = jnp.zeros((capacity,) + elem.shape, elem.dtype)
+        for i, e in enumerate(self.entries):
+            buf = buf.at[i].set(e)
+        return TensorArrayVal(buffer=buf,
+                              size=jnp.asarray(len(self.entries), jnp.int32))
+
+    def write(self, i, value) -> "TensorArrayVal":
+        if not self.buffered:
+            # list mode: under jit even constant indices are tracers, so
+            # writes APPEND (overwriting a concrete in-range index when one
+            # is available) — the reference LoDTensorArray's append-if-past-
+            # end behaviour, with sequential writes assumed otherwise
+            entries = list(self.entries)
+            if _is_concrete_index(i) and int(np.asarray(i)) < len(entries):
+                entries[int(np.asarray(i))] = value
+            else:
+                entries.append(value)
+            return TensorArrayVal(entries=entries)
+        i = jnp.asarray(i).reshape(()).astype(jnp.int32)
+        buf = jax.lax.dynamic_update_index_in_dim(self.buffer, value, i, 0)
+        return TensorArrayVal(buffer=buf, size=jnp.maximum(self.size, i + 1))
+
+    def read(self, i):
+        if not self.buffered:
+            if _is_concrete_index(i):
+                return self.entries[int(np.asarray(i))]
+            return jax.lax.dynamic_index_in_dim(
+                self.stack(), jnp.asarray(i).reshape(()).astype(jnp.int32),
+                0, keepdims=False)
+        i = jnp.asarray(i).reshape(()).astype(jnp.int32)
+        return jax.lax.dynamic_index_in_dim(self.buffer, i, 0, keepdims=False)
+
+    def length(self):
+        if self.buffered:
+            return self.size.reshape((1,)).astype(jnp.int64)
+        return jnp.asarray([len(self.entries)], jnp.int64)
+
+    def stack(self):
+        """Dense [T, ...] view (T = capacity in buffer mode, padded)."""
+        if self.buffered:
+            return self.buffer
+        return jnp.stack([jnp.asarray(e) for e in self.entries])
+
+
+_DEFAULT_CAPACITY = 128
+
+
+def _is_concrete_index(i) -> bool:
+    try:
+        int(np.asarray(i))
+        return True
+    except Exception:
+        return False
+
+
+def _ta_flatten(ta):
+    if ta.buffered:
+        return (ta.buffer, ta.size), ("buffered",)
+    return tuple(ta.entries), ("list",)
+
+
+def _ta_unflatten(aux, children):
+    if aux[0] == "buffered":
+        return TensorArrayVal(buffer=children[0], size=children[1])
+    return TensorArrayVal(entries=list(children))
+
+
+jax.tree_util.register_pytree_node(TensorArrayVal, _ta_flatten, _ta_unflatten)
+
+
+@register_op("create_array", outputs=["Out"], attrs={"dtype": "float32"},
+             grad=None, infer_shape=lambda op, block: None)
+def _create_array(ctx, ins, attrs):
+    return out(TensorArrayVal())
+
+
+@register_op("write_to_array", inputs=["X", IOSpec("I", no_grad=True),
+                                       IOSpec("Array", optional=True)],
+             outputs=["Out"], grad=None,
+             infer_shape=lambda op, block: None)
+def _write_to_array(ctx, ins, attrs):
+    arr = x(ins, "Array") or TensorArrayVal()
+    return out(arr.write(x(ins, "I"), x(ins, "X")))
+
+
+@register_op("read_from_array", inputs=["X", IOSpec("I", no_grad=True)],
+             outputs=["Out"], grad=None, infer_shape=lambda op, block: None)
+def _read_from_array(ctx, ins, attrs):
+    return out(x(ins, "X").read(x(ins, "I")))
+
+
+@register_op("lod_array_length", inputs=["X"], outputs=["Out"], grad=None,
+             infer_shape=lambda op, block: None)
+def _lod_array_length(ctx, ins, attrs):
+    return out(x(ins, "X").length())
+
+
+@register_op("tensor_array_to_tensor", inputs=["X"], outputs=["Out"],
+             attrs={"axis": 0}, grad=None,
+             infer_shape=lambda op, block: None)
+def _tensor_array_to_tensor(ctx, ins, attrs):
+    stacked = x(ins, "X").stack()
+    ax = attrs.get("axis", 0)
+    if ax == 0:
+        return out(stacked)
+    return out(jnp.moveaxis(stacked, 0, ax))
+
+
+# ---------------------------------------------------------------------------
+# while
+# ---------------------------------------------------------------------------
+
+def _as_pred(v):
+    return jnp.asarray(v).reshape(()).astype(bool)
+
+
+def _while_lower(ctx, op, env):
+    program = ctx.program
+    sub = program.blocks[op.attrs["sub_block"]]
+    cond_name = op.inputs["Condition"][0]
+    out_names = list(dict.fromkeys(op.outputs.get("Out", [])))
+    carry_names = [cond_name] + [n for n in out_names if n != cond_name]
+    capacity = int(op.attrs.get("max_len") or _DEFAULT_CAPACITY)
+
+    init = []
+    for n in carry_names:
+        v = env[n]
+        if isinstance(v, TensorArrayVal):
+            v = v.to_buffer(capacity)
+        init.append(v)
+
+    def cond_fn(carry):
+        return _as_pred(carry[0])
+
+    def body_fn(carry):
+        benv = dict(env)  # outer reads close over (loop-invariant)
+        benv.update(zip(carry_names, carry))
+        lower_block(sub, benv, ctx)
+        new = []
+        for n, old in zip(carry_names, carry):
+            v = benv[n]
+            if isinstance(v, TensorArrayVal) and not v.buffered:
+                v = v.to_buffer(capacity)
+            new.append(v)
+        return tuple(new)
+
+    final = jax.lax.while_loop(cond_fn, body_fn, tuple(init))
+    for n, v in zip(carry_names, final):
+        env[n] = v
+
+
+register_op("while",
+            inputs=[IOSpec("X", duplicable=True), IOSpec("Condition")],
+            outputs=[IOSpec("Out", duplicable=True),
+                     IOSpec("StepScopes", optional=True)],
+            attrs={"sub_block": None, "max_len": 0, "is_test": False},
+            grad=None, raw=True,
+            infer_shape=lambda op, block: None)(_while_lower)
+
+
+# ---------------------------------------------------------------------------
+# conditional_block
+# ---------------------------------------------------------------------------
+
+def _conditional_block_lower(ctx, op, env):
+    program = ctx.program
+    sub = program.blocks[op.attrs["sub_block"]]
+    pred = _as_pred(env[op.inputs["Cond"][0]])
+    out_names = list(dict.fromkeys(op.outputs.get("Out", [])))
+
+    def true_fn():
+        benv = dict(env)
+        lower_block(sub, benv, ctx)
+        return tuple(benv[n] for n in out_names)
+
+    shapes = jax.eval_shape(true_fn)
+
+    def false_fn():
+        # vars already defined keep their value; fresh outputs are zeros
+        # (reference conditional_block leaves them uninitialized; zeros is
+        # the defined TPU behaviour)
+        vals = []
+        for n, s in zip(out_names, shapes):
+            v = env.get(n)
+            vals.append(v if v is not None else jnp.zeros(s.shape, s.dtype))
+        return tuple(vals)
+
+    res = jax.lax.cond(pred, true_fn, false_fn)
+    for n, v in zip(out_names, res):
+        env[n] = v
+
+
+register_op("conditional_block",
+            inputs=[IOSpec("Cond"), IOSpec("Input", duplicable=True,
+                                           optional=True)],
+            outputs=[IOSpec("Out", duplicable=True),
+                     IOSpec("Scope", optional=True)],
+            attrs={"sub_block": None, "is_scalar_condition": True},
+            grad=None, raw=True,
+            infer_shape=lambda op, block: None)(_conditional_block_lower)
+
+
+# ---------------------------------------------------------------------------
+# recurrent (StaticRNN) — lax.scan, differentiable
+# ---------------------------------------------------------------------------
+
+def _recurrent_fn(ctx, op):
+    """Build fn(xs, init_states, params) -> (stacked_outputs, final_states)
+    from the op's sub-block; shared by forward and grad lowerings."""
+    sub = ctx.program.blocks[op.attrs["sub_block"]]
+    step_in_names = op.attrs["step_input_names"]     # sub-block var names
+    pre_names = op.attrs["pre_memory_names"]
+    new_names = op.attrs["new_memory_names"]
+    step_out_names = op.attrs["step_output_names"]
+    param_names = op.inputs.get("Params", [])
+
+    def fn(xs, init_states, params, outer_env):
+        def body(carry, xt):
+            benv = dict(outer_env)
+            benv.update(zip(param_names, params))
+            benv.update(zip(pre_names, carry))
+            benv.update(zip(step_in_names, xt))
+            lower_block(sub, benv, ctx)
+            new_carry = tuple(benv[n] for n in new_names)
+            ys = tuple(benv[n] for n in step_out_names)
+            return new_carry, ys
+
+        final, stacked = jax.lax.scan(body, tuple(init_states), tuple(xs))
+        return stacked, final
+
+    return fn
+
+
+def _recurrent_lower(ctx, op, env):
+    fn = _recurrent_fn(ctx, op)
+    xs = [env[n] for n in op.inputs.get("Inputs", [])]
+    init = [env[n] for n in op.inputs.get("InitStates", [])]
+    params = [env[n] for n in op.inputs.get("Params", [])]
+    stacked, final = fn(xs, init, params, env)
+    for n, v in zip(op.outputs.get("Outputs", []), stacked):
+        env[n] = v
+    for n, v in zip(op.outputs.get("FinalStates", []), final):
+        env[n] = v
+
+
+def _recurrent_grad_lower(ctx, op, env):
+    """Grad of recurrent: vjp through the scan (reference recurrent_grad —
+    backward-in-time loop with memory grads — is exactly scan's vjp)."""
+    fwd_ctx = ctx.with_uid(op.attrs.get("__fwd_uid__", 0))
+    # reconstruct a meta-op view with the forward's slots
+    fn = _recurrent_fn(fwd_ctx, _FwdView(op))
+    xs = [env[n] for n in op.inputs.get("Inputs", [])]
+    init = [env[n] for n in op.inputs.get("InitStates", [])]
+    params = [env[n] for n in op.inputs.get("Params", [])]
+
+    def wrapped(xs_, init_, params_):
+        stacked, final = fn(xs_, init_, params_, env)
+        return tuple(stacked) + tuple(final)
+
+    n_out = len(op.attrs["step_output_names"])
+    primal_out, vjp_fn = jax.vjp(wrapped, xs, init, params)
+    cts = []
+    grad_names = op.inputs.get("Outputs@GRAD", [])
+    final_grad_names = op.inputs.get("FinalStates@GRAD", [])
+    for i, val in enumerate(primal_out):
+        names = grad_names if i < n_out else final_grad_names
+        j = i if i < n_out else i - n_out
+        g = env.get(names[j]) if j < len(names) and names[j] != EMPTY else None
+        if g is None:
+            g = jnp.zeros_like(val)
+        cts.append(g.astype(val.dtype).reshape(val.shape))
+    gx, ginit, gparams = vjp_fn(tuple(cts))
+    for slot, grads in (("Inputs", gx), ("InitStates", ginit),
+                        ("Params", gparams)):
+        names = op.outputs.get(slot + "@GRAD", [])
+        for n, g in zip(names, grads):
+            if n != EMPTY and g is not None:
+                env[n] = g
+
+
+class _FwdView:
+    """Present a recurrent_grad op as its forward op (same attrs carry the
+    sub-block + name maps; inputs hold the forward slots untouched)."""
+
+    def __init__(self, grad_op):
+        self.attrs = grad_op.attrs
+        self.inputs = grad_op.inputs
+        self.outputs = {}
+        self.block = grad_op.block
+
+
+register_op("recurrent",
+            inputs=[IOSpec("Inputs", duplicable=True, optional=True),
+                    IOSpec("InitStates", duplicable=True, optional=True),
+                    IOSpec("Params", duplicable=True, optional=True)],
+            outputs=[IOSpec("Outputs", duplicable=True),
+                     IOSpec("FinalStates", duplicable=True, optional=True)],
+            attrs={"sub_block": None, "step_input_names": [],
+                   "pre_memory_names": [], "new_memory_names": [],
+                   "step_output_names": [], "is_test": False},
+            grad="auto", grad_lower=_recurrent_grad_lower, raw=True,
+            infer_shape=lambda op, block: None)(_recurrent_lower)
+
+
+# ---------------------------------------------------------------------------
+# beam search (dense batched; reference math/beam_search.cc is LoD-based)
+# ---------------------------------------------------------------------------
+
+@register_op("beam_search",
+             inputs=[IOSpec("pre_ids"), IOSpec("pre_scores"),
+                     IOSpec("ids", optional=True), IOSpec("scores")],
+             outputs=["selected_ids", "selected_scores", "parent_idx"],
+             attrs={"beam_size": 4, "end_id": 0, "level": 0,
+                    "is_accumulated": True}, grad=None)
+def _beam_search(ctx, ins, attrs):
+    """One beam step. scores: [batch*beam, K] candidate log-probs (already
+    accumulated if is_accumulated); pre_ids/pre_scores: [batch*beam, 1].
+    Finished beams (pre_id == end_id) propagate with unchanged score.
+    Outputs [batch*beam, 1] ids/scores and [batch*beam] parent indices."""
+    beam = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+    pre_ids = x(ins, "pre_ids").reshape(-1)          # [B*beam]
+    pre_scores = x(ins, "pre_scores").reshape(-1)
+    scores = x(ins, "scores")                         # [B*beam, K]
+    ids = x(ins, "ids")
+    nbk, k = scores.shape
+    batch = nbk // beam
+    if ids is None:
+        ids = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int64), (nbk, k))
+    if not attrs.get("is_accumulated", True):
+        scores = pre_scores[:, None] + jnp.log(
+            jnp.clip(scores, 1e-20, None))
+    finished = pre_ids == end_id
+    # finished beams contribute exactly one candidate: themselves
+    neg_inf = jnp.asarray(-1e9, scores.dtype)
+    cand_scores = jnp.where(finished[:, None], neg_inf, scores)
+    cand_scores = cand_scores.at[:, 0].set(
+        jnp.where(finished, pre_scores, cand_scores[:, 0]))
+    cand_ids = jnp.where(finished[:, None], end_id, ids)
+    # per source sequence: pick top beam over beam*K candidates
+    flat_scores = cand_scores.reshape(batch, beam * k)
+    top_scores, top_pos = jax.lax.top_k(flat_scores, beam)   # [B, beam]
+    src_beam = top_pos // k                                  # local parent
+    within = top_pos % k
+    parent = (jnp.arange(batch, dtype=jnp.int64)[:, None] * beam
+              + src_beam.astype(jnp.int64))                  # global row
+    sel_ids = jnp.take_along_axis(
+        cand_ids.reshape(batch, beam * k), top_pos, axis=1)
+    return {"selected_ids": [sel_ids.reshape(-1, 1).astype(jnp.int64)],
+            "selected_scores": [top_scores.reshape(-1, 1)],
+            "parent_idx": [parent.reshape(-1)]}
+
+
+@register_op("beam_search_decode",
+             inputs=[IOSpec("Ids"), IOSpec("Scores"),
+                     IOSpec("ParentIdx", optional=True)],
+             outputs=["SentenceIds", "SentenceScores"],
+             attrs={"beam_size": 4, "end_id": 0}, grad=None,
+             infer_shape=lambda op, block: None)
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrack beam pointers. Ids/Scores/ParentIdx are tensor arrays
+    written once per decode step: ids [B*beam,1], parents [B*beam].
+    Returns [T, B*beam] id/score matrices read through the parent chain
+    (rows beyond a sequence's end hold end_id)."""
+    ids_ta, sc_ta, par_ta = x(ins, "Ids"), x(ins, "Scores"), x(ins, "ParentIdx")
+    end_id = int(attrs.get("end_id", 0))
+    ids = ids_ta.stack()          # [T, B*beam, 1] (T = capacity if buffered)
+    scores = sc_ta.stack()
+    parents = par_ta.stack()      # [T, B*beam]
+    T = ids.shape[0]
+    nbk = ids.shape[1]
+    ids2 = ids.reshape(T, nbk)
+    scores2 = scores.reshape(T, nbk)
+    # buffered arrays may have unwritten tail rows (capacity > steps taken):
+    # mask them to identity-parent + end_id so backtracking passes through
+    if ids_ta.buffered:
+        valid = (jnp.arange(T) < ids_ta.size)[:, None]      # [T, 1]
+        ident = jnp.broadcast_to(
+            jnp.arange(nbk, dtype=parents.dtype), (T, nbk))
+        parents = jnp.where(valid, parents, ident)
+        ids2 = jnp.where(valid, ids2, end_id)
+        scores2 = jnp.where(valid, scores2, 0.0)
+
+    def back(carry, t):
+        ptr = carry                       # [B*beam] row to follow at step t
+        idt = ids2[t][ptr]
+        sct = scores2[t][ptr]
+        ptr = parents[t][ptr]
+        return ptr, (idt, sct)
+
+    init = jnp.arange(nbk, dtype=jnp.int64)
+    _, (out_ids, out_scores) = jax.lax.scan(
+        back, init, jnp.arange(T - 1, -1, -1))
+    # scan walked backwards: reverse to chronological order
+    return {"SentenceIds": [out_ids[::-1]],
+            "SentenceScores": [out_scores[::-1]]}

@@ -356,7 +356,9 @@ def _range(ctx, ins, attrs):
 @register_op("increment", inputs=["X"], outputs=["Out"], attrs={"step": 1.0},
              grad=None)
 def _increment(ctx, ins, attrs):
-    return out(x(ins) + attrs["step"])
+    xv = x(ins)
+    # keep X's dtype: int counters must stay int (loop carries require it)
+    return out(xv + jnp.asarray(attrs["step"], xv.dtype))
 
 
 @register_op("flatten2", inputs=["X"], outputs=["Out", "XShape"], attrs={"axis": 1})

@@ -1,0 +1,64 @@
+"""Seq2seq + beam search e2e (VERDICT item #2 done-criterion: a seq2seq model
+with beam-search decode runs; reference book/test_machine_translation.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models.seq2seq import (build_seq2seq_infer,
+                                       build_seq2seq_train)
+
+VOCAB = 12
+BATCH = 8
+SLEN = 5
+
+
+def _copy_batch(rng, batch):
+    """Copy task: target = source; bos=0 eos=1, tokens in [2, VOCAB)."""
+    src = rng.randint(2, VOCAB, (batch, SLEN)).astype(np.int64)
+    tgt_in = np.concatenate([np.zeros((batch, 1), np.int64), src[:, :-1]], 1)
+    return src, tgt_in, src
+
+
+def test_seq2seq_trains_and_beam_decodes():
+    rng = np.random.RandomState(0)
+    train = build_seq2seq_train(VOCAB, VOCAB, emb_dim=16, hidden=32,
+                                src_len=SLEN, tgt_len=SLEN, batch=BATCH,
+                                lr=5e-3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(train["startup"])
+        losses = []
+        for step in range(120):
+            src, tin, tout = _copy_batch(rng, BATCH)
+            losses.append(float(exe.run(
+                train["main"],
+                feed={"src_ids": src, "tgt_in_ids": tin, "tgt_out_ids": tout},
+                fetch_list=[train["loss"]])[0]))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+        # beam decode in the SAME scope (shared parameter names)
+        infer = build_seq2seq_infer(VOCAB, VOCAB, emb_dim=16, hidden=32,
+                                    src_len=SLEN, batch=4, beam_size=3,
+                                    max_len=SLEN)
+        # params already trained; run infer startup only for missing vars
+        src, _, _ = _copy_batch(rng, 4)
+        ids, scores = exe.run(infer["main"], feed={"src_ids": src},
+                              fetch_list=infer["fetches"])
+    nbk = 4 * 3
+    assert ids.shape == (SLEN + 1, nbk)
+    assert scores.shape == (SLEN + 1, nbk)
+    assert ids.min() >= 0 and ids.max() < VOCAB
+    # scores are accumulated log-probs: non-increasing over steps for the
+    # top beam of each source
+    assert np.isfinite(scores).all()
+
+
+def test_seq2seq_infer_program_serializes():
+    infer = build_seq2seq_infer(VOCAB, VOCAB, emb_dim=8, hidden=16,
+                                src_len=4, batch=2, beam_size=2, max_len=4)
+    j = infer["main"].to_json()
+    back = fluid.Program.from_json(j)
+    # sub-blocks survive the round-trip
+    assert len(back.blocks) == len(infer["main"].blocks)
+    types = [op.type for op in back.global_block.ops]
+    assert "while" in types and "beam_search_decode" in types
