@@ -68,6 +68,15 @@ def launch(args=None) -> int:
     if args.started_port:
         ports = [args.started_port + i for i in range(nproc)]
     else:
+        if len(node_ips) > 1:
+            # auto-discovered ports are LOCAL: other nodes would pick
+            # different ones and the cross-node endpoint lists (and the
+            # rank-0 coordinator address) would disagree
+            raise ValueError(
+                "multi-node launch (cluster_node_ips has "
+                f"{len(node_ips)} nodes) requires an explicit "
+                "--started_port so every node builds the same endpoint "
+                "list; port auto-discovery only works single-node")
         ports = find_free_ports(nproc)
     # endpoints for ALL nodes; this launcher starts only this node's procs
     endpoints = []

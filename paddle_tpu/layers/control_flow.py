@@ -108,30 +108,38 @@ def cond(pred: Variable, true_fn=None, false_fn=None):
 
     true_sub, true_out = run_branch(true_fn)
     false_sub, false_out = run_branch(false_fn)
-    true_reads, _ = _block_io(true_sub, parent)
-    false_reads, _ = _block_io(false_sub, parent)
+    true_reads, true_writes = _block_io(true_sub, parent)
+    false_reads, false_writes = _block_io(false_sub, parent)
     if len(true_out) != len(false_out):
         raise ValueError("cond branches must return the same structure")
-    outs = []
-    for tv, fv in zip(true_out, false_out):
-        out = helper.create_variable_for_type_inference(tv.dtype)
-        out.shape = tv.shape
-        outs.append(out)
-        # merge = select(pred, true_result, false_result); each branch block
-        # is lowered lazily by its conditional_block op
+
+    # ONE conditional_block per branch. Out = branch return vars PLUS every
+    # outer var the branch writes, so side effects (assigns to enclosing-scope
+    # vars) survive lowering — the reference tracks all sub-block writes the
+    # same way. Emitted even when the branch returns nothing: the writes are
+    # the observable effect.
+    t_outs = list(dict.fromkeys([v.name for v in true_out] + true_writes))
+    f_outs = list(dict.fromkeys([v.name for v in false_out] + false_writes))
+    if t_outs or true_sub.ops:
         parent.append_op(
             "conditional_block",
             inputs={"Cond": [pred.name], "Input": true_reads},
-            outputs={"Out": [tv.name]},
+            outputs={"Out": t_outs},
             attrs={"sub_block": true_sub.idx})
+    if f_outs or false_sub.ops:
         notp = helper.create_variable_for_type_inference("bool")
         parent.append_op("logical_not", inputs={"X": pred},
                          outputs={"Out": notp})
         parent.append_op(
             "conditional_block",
             inputs={"Cond": [notp.name], "Input": false_reads},
-            outputs={"Out": [fv.name]},
+            outputs={"Out": f_outs},
             attrs={"sub_block": false_sub.idx})
+    outs = []
+    for tv, fv in zip(true_out, false_out):
+        out = helper.create_variable_for_type_inference(tv.dtype)
+        out.shape = tv.shape
+        outs.append(out)
         parent.append_op("where", inputs={"Condition": pred, "X": tv,
                                           "Y": fv},
                          outputs={"Out": out})

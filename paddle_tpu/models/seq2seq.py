@@ -127,7 +127,14 @@ def build_seq2seq_infer(src_vocab, tgt_vocab, emb_dim=32, hidden=64,
                            i, par_arr)
         n = layers.fill_constant([1], "int64", max_len)
         pre_ids = layers.fill_constant([nbk, 1], "int64", bos_id)
-        pre_scores = layers.fill_constant([nbk, 1], "float32", 0.0)
+        # Only beam slot 0 of each source enters step 0 live; slots 1..K-1
+        # start at -1e9 so top-k doesn't select K identical candidates from
+        # the K duplicated parent rows (the reference starts with one beam
+        # per source via LoD; with dense fixed-width beams the mask does it).
+        init_scores = np.where(
+            (np.arange(nbk) % beam_size == 0)[:, None], 0.0, -1e9
+        ).astype(np.float32)
+        pre_scores = layers.assign(init_scores)
         cond = layers.less_than(i, n)
         w = layers.While(cond, max_len=max_len + 1)
         with w.block():

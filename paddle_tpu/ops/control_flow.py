@@ -256,6 +256,16 @@ def _conditional_block_lower(ctx, op, env):
         vals = []
         for n, s in zip(out_names, shapes):
             v = env.get(n)
+            if v is not None and not isinstance(v, TensorArrayVal):
+                va = jnp.asarray(v)
+                if (tuple(va.shape), va.dtype) != (tuple(s.shape), s.dtype):
+                    raise ValueError(
+                        f"conditional_block output '{n}': the sub-block "
+                        f"produces shape {tuple(s.shape)} dtype {s.dtype} but "
+                        f"the pre-existing value (kept when the condition is "
+                        f"false) has shape {tuple(va.shape)} dtype {va.dtype}"
+                        f" — both branches of a conditional must agree; avoid"
+                        f" reshaping/recasting an outer var inside the block")
             vals.append(v if v is not None else jnp.zeros(s.shape, s.dtype))
         return tuple(vals)
 

@@ -244,3 +244,67 @@ def test_beam_search_decode_backtrack():
     np.testing.assert_array_equal(ids[:, 0], [22, 33])
     # beam 1 final: token 44 at t1, parent 1 -> token 22 at t0
     np.testing.assert_array_equal(ids[:, 1], [22, 44])
+
+
+def test_cond_carries_side_effects():
+    """Round-2 advisor: assigns to outer vars inside a cond branch must
+    survive lowering even when the branch returns nothing."""
+    import paddle_tpu.layers as layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], append_batch_size=False)
+        acc = layers.create_tensor("float32", persistable=True)
+        layers.assign(np.zeros(4, np.float32), acc)
+        pred = layers.less_than(layers.reduce_sum(x),
+                                layers.fill_constant([1], "float32", 0.0))
+
+        def neg_branch():
+            layers.assign(x * 2.0, acc)
+
+        def pos_branch():
+            layers.assign(x * 3.0, acc)
+
+        res = layers.cond(pred, neg_branch, pos_branch)
+        assert res is None
+        out = acc + 1.0
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xv = np.array([1.0, 2.0, 3.0, 4.0], np.float32)  # sum > 0
+        r = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+        np.testing.assert_allclose(r, xv * 3.0 + 1.0, rtol=1e-6)
+        xn = -xv
+        r = exe.run(main, feed={"x": xn}, fetch_list=[out])[0]
+        np.testing.assert_allclose(r, xn * 2.0 + 1.0, rtol=1e-6)
+
+
+def test_conditional_block_shape_mismatch_clear_error():
+    """Round-2 advisor: reshaping an outer var inside a branch must raise a
+    clear error naming the variable, not an opaque lax.cond structure error."""
+    import pytest
+
+    import paddle_tpu.layers as layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], append_batch_size=False)
+        y = layers.create_tensor("float32", persistable=True)
+        layers.assign(np.zeros(4, np.float32), y)
+        pred = layers.less_than(layers.reduce_sum(x),
+                                layers.fill_constant([1], "float32", 0.0))
+
+        def bad_branch():
+            layers.assign(layers.reshape(x, [2, 2]), y)
+
+        layers.cond(pred, bad_branch, None)
+        out = layers.reduce_sum(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(Exception, match="conditional_block output"):
+            exe.run(main, feed={"x": np.ones(4, np.float32)},
+                    fetch_list=[out])

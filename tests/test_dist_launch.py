@@ -60,3 +60,15 @@ def test_launcher_propagates_failure():
          "/nonexistent_script.py"],
         env=env, capture_output=True, text=True, timeout=120)
     assert r.returncode != 0
+
+
+def test_multinode_launch_requires_explicit_port():
+    """Round-2 advisor: auto-discovered ports disagree across nodes."""
+    import pytest
+
+    from paddle_tpu.distributed.launch import _parse_args, launch
+
+    args = _parse_args(["--cluster_node_ips", "10.0.0.1,10.0.0.2",
+                        "--node_ip", "10.0.0.1", "dummy.py"])
+    with pytest.raises(ValueError, match="started_port"):
+        launch(args)

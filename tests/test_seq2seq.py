@@ -62,3 +62,36 @@ def test_seq2seq_infer_program_serializes():
     assert len(back.blocks) == len(infer["main"].blocks)
     types = [op.type for op in back.global_block.ops]
     assert "while" in types and "beam_search_decode" in types
+
+
+def test_beam_search_beams_diverge():
+    """Round-2 advisor: identical beam slots at step 0 made search greedy —
+    with the -1e9 non-first-slot init, distinct hypotheses must survive."""
+    rng = np.random.RandomState(1)
+    train = build_seq2seq_train(VOCAB, VOCAB, emb_dim=16, hidden=32,
+                                src_len=SLEN, tgt_len=SLEN, batch=BATCH,
+                                lr=5e-3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    beam = 3
+    with fluid.scope_guard(scope):
+        exe.run(train["startup"])
+        for _ in range(30):
+            src, tin, tout = _copy_batch(rng, BATCH)
+            exe.run(train["main"],
+                    feed={"src_ids": src, "tgt_in_ids": tin,
+                          "tgt_out_ids": tout}, fetch_list=[train["loss"]])
+        infer = build_seq2seq_infer(VOCAB, VOCAB, emb_dim=16, hidden=32,
+                                    src_len=SLEN, batch=4, beam_size=beam,
+                                    max_len=SLEN)
+        src, _, _ = _copy_batch(rng, 4)
+        ids, scores = exe.run(infer["main"], feed={"src_ids": src},
+                              fetch_list=infer["fetches"])
+    # per source: the beam hypotheses (token sequences over time) must not
+    # all be identical
+    diverged = 0
+    for b in range(4):
+        hyps = {tuple(ids[:, b * beam + k]) for k in range(beam)}
+        if len(hyps) > 1:
+            diverged += 1
+    assert diverged >= 2, f"beams collapsed to greedy: {diverged}/4 diverged"
