@@ -18,8 +18,13 @@ from .framework import (Block, Operator, Parameter, Program, Variable,
                         in_dygraph_mode, name_scope, program_guard)
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .parallel import BuildStrategy, CompiledProgram, ExecutionStrategy
+from . import contrib
+from . import dataset
 from . import distributed
 from . import io
+from . import reader
+from .data_feeder import DataFeeder
+from .reader import DataLoader, PyReader, batch
 from . import metrics
 from . import optimizer
 from . import profiler

@@ -183,7 +183,9 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
     [(param, grad_var), ...] like the reference (backward.py:916)."""
     block = loss.block
     program: Program = block.program
-    acc = _append_backward_core(block, [loss], None, set(no_grad_set or ()))
+    with program._op_role_guard("backward"):
+        acc = _append_backward_core(block, [loss], None,
+                                    set(no_grad_set or ()))
 
     params = (program.all_parameters() if parameter_list is None else [
         block._var_recursive(p) if isinstance(p, str) else p

@@ -1,0 +1,2 @@
+"""fluid.contrib namespace (reference: python/paddle/fluid/contrib/)."""
+from . import mixed_precision  # noqa: F401

@@ -1,0 +1,3 @@
+"""Mixed precision (AMP) — reference: fluid/contrib/mixed_precision/."""
+from .decorator import OptimizerWithMixedPrecision, decorate  # noqa: F401
+from .fp16_lists import AutoMixedPrecisionLists  # noqa: F401

@@ -1,0 +1,64 @@
+"""DataFeeder: convert python/numpy minibatches into executor feeds.
+
+Reference: python/paddle/fluid/data_feeder.py (DataFeeder.feed converts a
+list of sample tuples into per-variable LoDTensors on the target place).
+Here the target representation is a dict name -> numpy batch; device
+placement happens in the executor (or ahead of time in the DataLoader).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from .core.types import np_dtype
+from .framework import Variable
+
+__all__ = ["DataFeeder", "coerce_feed_array"]
+
+
+def coerce_feed_array(var: Variable, arr: np.ndarray) -> np.ndarray:
+    """Coerce one batched array to a feed variable's declared dtype/rank:
+    same-kind dtype cast, and label scalars fed as [N, 1] (the reference
+    DataFeeder's LoDTensor convention). Shared by DataFeeder and the
+    DataLoader staging path."""
+    want = np_dtype(var.dtype)
+    if arr.dtype != want and arr.dtype.kind == np.dtype(want).kind:
+        arr = arr.astype(want)
+    if var.shape is not None and arr.ndim == len(var.shape) - 1:
+        arr = arr.reshape(arr.shape + (1,))
+    return arr
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence, place=None, program=None):
+        self.feed_names: List[str] = []
+        self.feed_vars: List[Variable] = []
+        for v in feed_list:
+            if isinstance(v, str):
+                if program is None:
+                    raise ValueError("string feed names need a program")
+                v = program.global_block.var(v)
+            self.feed_vars.append(v)
+            self.feed_names.append(v.name)
+        self.place = place
+
+    def feed(self, iterable) -> Dict[str, np.ndarray]:
+        """iterable: list of sample tuples, one tuple per example, fields
+        aligned with feed_list. Returns {name: batched ndarray} with dtypes
+        coerced to each variable's declared dtype."""
+        samples = list(iterable)
+        if not samples:
+            raise ValueError("empty minibatch")
+        cols = list(zip(*[s if isinstance(s, (list, tuple)) else (s,)
+                          for s in samples]))
+        if len(cols) != len(self.feed_names):
+            raise ValueError(
+                f"sample has {len(cols)} fields, feed_list expects "
+                f"{len(self.feed_names)} ({self.feed_names})")
+        out = {}
+        for var, col in zip(self.feed_vars, cols):
+            arr = np.stack([np.asarray(v, dtype=np_dtype(var.dtype))
+                            for v in col])
+            out[var.name] = coerce_feed_array(var, arr)
+        return out

@@ -1,0 +1,14 @@
+"""Built-in dataset loaders (reference: python/paddle/dataset/).
+
+The reference downloads real archives from paddlepaddle.org
+(dataset/common.py download()). This build environment has NO network
+egress, so each loader first looks for the real files in
+``~/.cache/paddle_tpu/dataset`` (drop them there to train on real data) and
+otherwise falls back to a deterministic synthetic sample with the exact
+shapes/dtypes/value-ranges of the real dataset — enough to drive every
+pipeline, model and test. The reader contract is the reference one: a
+loader returns a zero-arg creator whose iterator yields sample tuples.
+"""
+from . import cifar, imdb, mnist, uci_housing  # noqa: F401
+
+__all__ = ["mnist", "cifar", "imdb", "uci_housing"]

@@ -44,6 +44,20 @@ GRAD_VAR_SUFFIX = "@GRAD"
 ZERO_VAR_SUFFIX = "@ZERO"
 
 
+class OpRole:
+    """Role stamped on every op at append time (reference
+    op_proto_maker.h OpRole + framework.py _current_role): lets
+    ``clone(for_test=True)`` prune the backward/optimize/lr parts the way
+    the reference's ``core.prune_backward`` does."""
+
+    Forward = "forward"
+    Backward = "backward"
+    Optimize = "optimize"
+    LRSched = "lr_sched"
+
+    PRUNE_FOR_TEST = (Backward, Optimize, LRSched)
+
+
 def grad_var_name(name: str) -> str:
     return name + GRAD_VAR_SUFFIX
 
@@ -364,23 +378,27 @@ class Block:
         return [v for v in self.vars.values() if isinstance(v, Parameter)]
 
     # -- op management ---------------------------------------------------
+    def _stamp(self, op: Operator) -> None:
+        op.attrs.setdefault("__uid__", self.program._next_uid())
+        op.attrs.setdefault("__op_role__", self.program._op_role)
+
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
         op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
-        op.attrs.setdefault("__uid__", self.program._next_uid())
+        self._stamp(op)
         self.ops.append(op)
         op.infer_shape()
         return op
 
     def prepend_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
         op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
-        op.attrs.setdefault("__uid__", self.program._next_uid())
+        self._stamp(op)
         self.ops.insert(0, op)
         op.infer_shape()
         return op
 
     def insert_op(self, index: int, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
         op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
-        op.attrs.setdefault("__uid__", self.program._next_uid())
+        self._stamp(op)
         self.ops.insert(index, op)
         op.infer_shape()
         return op
@@ -409,6 +427,8 @@ class Program:
         self.random_seed = 0
         # bumped on structural/attr mutation; part of the executor cache key
         self._version = 0
+        # role stamped on appended ops (reference _current_role)
+        self._op_role = OpRole.Forward
 
     def _next_uid(self) -> int:
         self._uid_counter += 1
@@ -417,6 +437,14 @@ class Program:
 
     def _bump_version(self) -> None:
         self._version += 1
+
+    @contextlib.contextmanager
+    def _op_role_guard(self, role: str):
+        old, self._op_role = self._op_role, role
+        try:
+            yield
+        finally:
+            self._op_role = old
 
     # -- blocks ----------------------------------------------------------
     @property
@@ -445,7 +473,18 @@ class Program:
         p = Program.from_dict(self.to_dict())
         p._uid_counter = self._uid_counter
         p.random_seed = self.random_seed
+        # the AMP compute policy is program state, not op metadata: carry it
+        # so eval clones of a decorated program also run bf16
+        if getattr(self, "_amp_policy", None) is not None:
+            p._amp_policy = self._amp_policy
         if for_test:
+            # prune the backward/optimize/lr-sched parts (reference
+            # core.prune_backward called from clone framework.py:3571):
+            # keeping them would make "inference" runs mutate parameters
+            for blk in p.blocks:
+                blk.ops = [op for op in blk.ops
+                           if op.attrs.get("__op_role__", OpRole.Forward)
+                           not in OpRole.PRUNE_FOR_TEST]
             for blk in p.blocks:
                 for op in blk.ops:
                     if "is_test" in op.attrs:
@@ -463,10 +502,18 @@ class Program:
 
     # -- serialization ---------------------------------------------------
     def to_dict(self) -> dict:
-        return {
+        d = {
             "version": 1,
             "blocks": [b.to_dict() for b in self.blocks],
         }
+        amp = getattr(self, "_amp_policy", None)
+        if amp is not None:
+            # program-level compute policy must survive serde: a deserialized
+            # inference program silently reverting to fp32 is a perf bug
+            d["amp_policy"] = {"white": sorted(amp.white),
+                               "black": sorted(amp.black),
+                               "compute_dtype": str(amp.compute_dtype)}
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "Program":
@@ -505,6 +552,12 @@ class Program:
                 op = Operator.from_dict(b, od)
                 b.ops.append(op)
                 p._uid_counter = max(p._uid_counter, op.attrs.get("__uid__", 0))
+        if d.get("amp_policy"):
+            from .lowering import AmpPolicy
+
+            ap = d["amp_policy"]
+            p._amp_policy = AmpPolicy(ap["white"], ap["black"],
+                                      ap["compute_dtype"])
         return p
 
     def to_json(self) -> str:

@@ -212,3 +212,8 @@ def load_checkpoint(executor, dirname, main_program=None, scope=None) -> dict:
                       scope=scope)
     meta_path = os.path.join(dirname, "meta.json")
     return json.load(open(meta_path)) if os.path.exists(meta_path) else {}
+
+
+# reference fluid.io re-exports the data pipeline (python/paddle/fluid/io.py
+# pulls DataLoader/PyReader from reader.py)
+from .reader import DataLoader, PyReader  # noqa: E402,F401

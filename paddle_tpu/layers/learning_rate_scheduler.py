@@ -35,9 +35,11 @@ def _decay_step_counter(begin=0):
         startup.append_op("fill_constant", outputs={"Out": LR_COUNTER_NAME},
                           attrs={"shape": [1], "dtype": "float32",
                                  "value": float(begin) - 1.0})
+        # lr_sched role: pruned by clone(for_test=True) so inference runs
+        # don't advance the schedule (reference OpRole.LRSched)
         main.prepend_op("increment", inputs={"X": LR_COUNTER_NAME},
                         outputs={"Out": LR_COUNTER_NAME},
-                        attrs={"step": 1.0})
+                        attrs={"step": 1.0, "__op_role__": "lr_sched"})
     return main.var(LR_COUNTER_NAME)
 
 

@@ -100,7 +100,7 @@ def _encoder_layer(x, mask, cfg: BertConfig, prefix: str, is_test: bool = False)
 
 def build_bert_pretrain(cfg: BertConfig = None, seq_len: int = 128,
                         lr: float = 1e-4, build_optimizer: bool = True,
-                        is_test: bool = False):
+                        is_test: bool = False, amp: bool = False):
     """Returns the pretraining Program: feeds are
     src_ids/pos_ids/sent_ids/input_mask [B,S], mask_label [B,S] (with -100 on
     unmasked positions), next_sent_label [B,1]."""
@@ -183,7 +183,12 @@ def build_bert_pretrain(cfg: BertConfig = None, seq_len: int = 128,
 
         loss = layers.elementwise_add(mlm_loss, nsp_loss)
         if build_optimizer:
-            opt_mod.Adam(learning_rate=lr).minimize(loss)
+            opt = opt_mod.Adam(learning_rate=lr)
+            if amp:
+                from ..contrib import mixed_precision as _mp
+
+                opt = _mp.decorate(opt)
+            opt.minimize(loss)
     return {"main": main, "startup": startup, "loss": loss,
             "mlm_loss": mlm_loss, "nsp_loss": nsp_loss,
             "feeds": ("src_ids", "pos_ids", "sent_ids", "input_mask",

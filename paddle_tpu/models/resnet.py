@@ -43,7 +43,7 @@ def _basic(x, filters, stride):
 
 
 def build_resnet(depth=50, class_num=1000, image_shape=(3, 224, 224),
-                 lr=0.1, momentum=0.9, build_optimizer=True):
+                 lr=0.1, momentum=0.9, build_optimizer=True, amp=False):
     block_fn_name, counts = _DEPTH_CFG[depth]
     block_fn = _bottleneck if block_fn_name == "bottleneck" else _basic
     main, startup = Program(), Program()
@@ -63,6 +63,11 @@ def build_resnet(depth=50, class_num=1000, image_shape=(3, 224, 224),
             layers.softmax_with_cross_entropy(logits, label))
         acc = layers.accuracy(logits, label)
         if build_optimizer:
-            opt_mod.Momentum(learning_rate=lr, momentum=momentum).minimize(loss)
+            opt = opt_mod.Momentum(learning_rate=lr, momentum=momentum)
+            if amp:
+                from ..contrib import mixed_precision as _mp
+
+                opt = _mp.decorate(opt)
+            opt.minimize(loss)
     return {"main": main, "startup": startup, "loss": loss, "acc": acc,
             "feeds": ("img", "label"), "logits": logits}

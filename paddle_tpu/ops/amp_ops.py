@@ -1,0 +1,71 @@
+"""Mixed-precision support ops: gradient finiteness check + loss scaling.
+
+Reference: the AMP decorator's scale/unscale logic
+(python/paddle/fluid/contrib/mixed_precision/decorator.py:120-208) which the
+reference builds out of isfinite/scale/cast ops; here the two composite steps
+are single ops so the whole check lowers to a handful of fused XLA reductions.
+bf16 training on TPU does not need loss scaling at all (same exponent range as
+fp32) — the machinery exists for fp16-compat API parity and is exercised by
+tests with fp16-style dynamic scaling settings.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .common import IOSpec, out, register_op, x
+
+
+@register_op("check_finite_and_unscale",
+             inputs=[IOSpec("X", duplicable=True), IOSpec("Scale")],
+             outputs=[IOSpec("Out", duplicable=True),
+                      IOSpec("FoundInfinite")],
+             grad=None, infer_shape=lambda op, block: None)
+def _check_finite_and_unscale(ctx, ins, attrs):
+    """Out_i = X_i / Scale, zeroed when ANY X_i has a non-finite element;
+    FoundInfinite is the bool flag. Zeroing (instead of the reference's
+    skip-update) keeps the step a single static XLA program: an optimizer
+    step over zero grads leaves params unchanged."""
+    xs = ins.get("X", [])
+    scale = x(ins, "Scale").reshape(()).astype(jnp.float32)
+    found = jnp.zeros((), bool)
+    for v in xs:
+        found = found | ~jnp.all(jnp.isfinite(v.astype(jnp.float32)))
+    outs = []
+    for v in xs:
+        unscaled = (v.astype(jnp.float32) / scale).astype(v.dtype)
+        outs.append(jnp.where(found, jnp.zeros_like(unscaled), unscaled))
+    return {"Out": outs, "FoundInfinite": [found.reshape((1,))]}
+
+
+@register_op("update_loss_scaling",
+             inputs=[IOSpec("FoundInfinite"), IOSpec("PrevLossScaling"),
+                     IOSpec("InGoodSteps"), IOSpec("InBadSteps")],
+             outputs=["LossScaling", "OutGoodSteps", "OutBadSteps"],
+             attrs={"incr_every_n_steps": 1000,
+                    "decr_every_n_nan_or_inf": 2,
+                    "incr_ratio": 2.0, "decr_ratio": 0.5},
+             grad=None, infer_shape=lambda op, block: None)
+def _update_loss_scaling(ctx, ins, attrs):
+    """Dynamic loss-scale state machine (reference decorator.py:167
+    update_loss_scaling): grow scale after N consecutive finite steps,
+    shrink after M nan/inf steps."""
+    found = x(ins, "FoundInfinite").reshape(()).astype(bool)
+    scale = x(ins, "PrevLossScaling").reshape(()).astype(jnp.float32)
+    good = x(ins, "InGoodSteps").reshape(()).astype(jnp.int32)
+    bad = x(ins, "InBadSteps").reshape(()).astype(jnp.int32)
+    incr_n = int(attrs["incr_every_n_steps"])
+    decr_n = int(attrs["decr_every_n_nan_or_inf"])
+    incr, decr = float(attrs["incr_ratio"]), float(attrs["decr_ratio"])
+
+    new_good = jnp.where(found, 0, good + 1)
+    new_bad = jnp.where(found, bad + 1, 0)
+    do_incr = new_good >= incr_n
+    do_decr = new_bad >= decr_n
+    new_scale = jnp.where(do_incr, scale * incr,
+                          jnp.where(do_decr, jnp.maximum(scale * decr, 1.0),
+                                    scale))
+    new_good = jnp.where(do_incr | do_decr, 0, new_good)
+    new_bad = jnp.where(do_incr | do_decr, 0, new_bad)
+    return {"LossScaling": [new_scale.reshape((1,))],
+            "OutGoodSteps": [new_good.reshape((1,))],
+            "OutBadSteps": [new_bad.reshape((1,))]}

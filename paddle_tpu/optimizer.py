@@ -115,8 +115,10 @@ class Optimizer:
         # default happens to be (reference optimizer.py apply_optimize wraps
         # in program_guard(loss.block.program, startup)).
         program = params_grads[0][0].block.program
-        with program_guard(program):
-            block = program.global_block
+        with program_guard(program), program._op_role_guard("optimize"):
+            # current_block, not global: lets wrappers (AMP skip-update)
+            # run the whole update inside a conditional sub-block
+            block = program.current_block()
             params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
             params_grads = append_gradient_clip_ops(params_grads)
             params_grads = append_regularization_ops(params_grads,

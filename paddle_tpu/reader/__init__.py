@@ -1,0 +1,229 @@
+"""Data pipeline: DataLoader / PyReader + reader combinators.
+
+Reference: python/paddle/fluid/reader.py:73 (DataLoader.from_generator),
+:298 (GeneratorLoader pushing LoDTensors into a C++ LoDTensorBlockingQueue
+read by a create_py_reader op), :569 (PyReader), and the C++ double-buffer
+prefetch in paddle/fluid/operators/reader/buffered_reader.cc.
+
+TPU-native redesign: there is no reader op inside the graph. The loader is a
+host-side pipeline — background thread runs the user generator, converts
+batches to arrays and issues ``jax.device_put`` (async on TPU: the transfer
+overlaps compute exactly like BufferedReader's side-stream memcpy), then a
+bounded queue hands device-resident batches to the train loop, which passes
+them to ``exe.run(feed=...)`` where they are used as-is (no extra copy).
+``capacity`` plays the role of the blocking queue depth; >=2 gives double
+buffering.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .decorator import (buffered, cache, chain, compose, firstn,  # noqa: F401
+                        map_readers, multiprocess_reader, shuffle,
+                        xmap_readers)
+
+__all__ = ["DataLoader", "PyReader", "batch", "cache", "map_readers",
+           "buffered", "compose", "chain", "shuffle", "firstn",
+           "xmap_readers", "multiprocess_reader"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference python/paddle/batch.py: sample reader -> sample-list
+    reader."""
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+class _EndOfEpoch:
+    pass
+
+
+_EOE = _EndOfEpoch()
+
+
+class DataLoader:
+    """reference reader.py:73. Construct via ``from_generator``."""
+
+    def __init__(self, feed_list=None, capacity=4, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        if feed_list is None:
+            raise ValueError("feed_list is required (list of fluid.data vars)")
+        self._feed_names = [v if isinstance(v, str) else v.name
+                            for v in feed_list]
+        self._feed_vars = [v for v in feed_list if not isinstance(v, str)]
+        self._capacity = max(2, int(capacity)) if use_double_buffer \
+            else max(1, int(capacity))
+        self._use_double_buffer = use_double_buffer
+        self._iterable = iterable
+        self._return_list = return_list
+        self._places = None
+        self._batch_reader: Optional[Callable] = None
+        # non-iterable (start/reset) mode state
+        self._thread = None
+        self._queue: Optional[queue.Queue] = None
+
+    # -- construction (reference DataLoader.from_generator) ---------------
+    @staticmethod
+    def from_generator(feed_list=None, capacity=4, use_double_buffer=True,
+                       iterable=True, return_list=False):
+        return DataLoader(feed_list, capacity, use_double_buffer, iterable,
+                          return_list)
+
+    # -- generator wiring (reference GeneratorLoader.set_*) ---------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        """reader yields ONE sample (tuple of arrays); loader batches."""
+
+        def batch_reader():
+            buf = []
+            for sample in reader():
+                buf.append(sample if isinstance(sample, (list, tuple))
+                           else (sample,))
+                if len(buf) == batch_size:
+                    yield _stack_samples(buf)
+                    buf = []
+            if buf and not drop_last:
+                yield _stack_samples(buf)
+
+        return self.set_batch_generator(batch_reader, places)
+
+    def set_sample_list_generator(self, reader, places=None):
+        """reader yields a LIST of samples per iteration (a batch)."""
+
+        def batch_reader():
+            for samples in reader():
+                yield _stack_samples([s if isinstance(s, (list, tuple))
+                                      else (s,) for s in samples])
+
+        return self.set_batch_generator(batch_reader, places)
+
+    def set_batch_generator(self, reader, places=None):
+        """reader yields ready batches (tuple/list of batched arrays)."""
+        self._batch_reader = reader
+        self._places = places
+        return self
+
+    # -- device staging ----------------------------------------------------
+    def _stage(self, batch):
+        """Convert one batch to device arrays keyed by feed name. device_put
+        is asynchronous: the host->device copy of batch N+1 overlaps the
+        compute of batch N (BufferedReader's double-buffer, compiler-free)."""
+        import jax
+
+        if isinstance(batch, dict):
+            items = [(n, batch[n]) for n in self._feed_names]
+        else:
+            vals = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+            if len(vals) != len(self._feed_names):
+                raise ValueError(
+                    f"generator yielded {len(vals)} arrays but feed_list has "
+                    f"{len(self._feed_names)} ({self._feed_names})")
+            items = list(zip(self._feed_names, vals))
+        dev = None
+        if self._places:
+            place = self._places[0] if isinstance(self._places, (list, tuple)) \
+                else self._places
+            dev = place.jax_device() if hasattr(place, "jax_device") else place
+        out = {}
+        from ..data_feeder import coerce_feed_array
+
+        for name, v in items:
+            arr = np.asarray(v)
+            for var in self._feed_vars:
+                if var.name == name:
+                    arr = coerce_feed_array(var, arr)
+                    break
+            out[name] = jax.device_put(arr, dev) if dev is not None \
+                else jax.device_put(arr)
+        return out
+
+    # -- iteration ---------------------------------------------------------
+    def __iter__(self):
+        if self._batch_reader is None:
+            raise RuntimeError("call set_sample_generator / "
+                               "set_sample_list_generator / "
+                               "set_batch_generator first")
+        q: queue.Queue = queue.Queue(maxsize=self._capacity)
+        stop = threading.Event()
+
+        def worker():
+            try:
+                for batch in self._batch_reader():
+                    if stop.is_set():
+                        return
+                    q.put(self._stage(batch))
+                q.put(_EOE)
+            except BaseException as e:  # surface in the consumer
+                q.put(e)
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="paddle_tpu-dataloader")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _EOE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                if self._return_list:
+                    yield [item[n] for n in self._feed_names]
+                else:
+                    yield item
+        finally:
+            stop.set()
+            # drain so the worker unblocks and exits
+            while t.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    t.join(timeout=0.1)
+
+    # -- non-iterable start/reset mode (reference PyReader) ---------------
+    def start(self):
+        self._iter = iter(self)
+
+    def next(self):
+        return next(self._iter)
+
+    def reset(self):
+        self._iter = None
+
+
+class PyReader(DataLoader):
+    """reference reader.py:569 — the older name for the same machinery."""
+
+    def __init__(self, feed_list=None, capacity=4, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        super().__init__(feed_list, capacity, use_double_buffer, iterable,
+                         return_list)
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        return self.set_sample_generator(sample_generator, batch_size,
+                                         drop_last, places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.set_batch_generator(reader, places)
+
+
+def _stack_samples(samples: List[tuple]) -> tuple:
+    cols = list(zip(*samples))
+    return tuple(np.stack([np.asarray(v) for v in col]) for col in cols)

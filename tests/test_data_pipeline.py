@@ -1,0 +1,149 @@
+"""Data pipeline: DataLoader / PyReader / DataFeeder / datasets / prefetch
+(VERDICT r2 item #2; reference python/paddle/fluid/reader.py:73,569,
+data_feeder.py, reader/buffered_reader.cc, python/paddle/dataset/).
+"""
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+
+
+def _mnist_mlp():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[784])
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(img, 128, act="relu")
+        logits = layers.fc(h, 10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        optimizer.Adam(1e-3).minimize(loss)
+    return main, startup, img, label, loss
+
+
+def test_dataloader_trains_mnist():
+    main, startup, img, label, loss = _mnist_mlp()
+    loader = fluid.DataLoader.from_generator(feed_list=[img, label],
+                                             capacity=4)
+    reader = fluid.reader.shuffle(fluid.dataset.mnist.train(), 1024)
+    loader.set_sample_generator(reader, batch_size=64,
+                                places=fluid.CPUPlace())
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for feed in loader:
+            assert feed["img"].shape == (64, 784)
+            assert feed["label"].shape == (64, 1)
+            losses.append(float(exe.run(main, feed=feed,
+                                        fetch_list=[loss])[0]))
+    assert len(losses) == 8192 // 64
+    assert np.mean(losses[-10:]) < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_dataloader_batch_and_sample_list_generators():
+    main, startup, img, label, loss = _mnist_mlp()
+    loader = fluid.DataLoader.from_generator(feed_list=[img, label],
+                                             capacity=2, return_list=True)
+    loader.set_sample_list_generator(
+        fluid.batch(fluid.dataset.mnist.test(), 32, drop_last=True))
+    n = 0
+    for img_v, lbl_v in loader:
+        assert img_v.shape == (32, 784) and lbl_v.shape == (32, 1)
+        n += 1
+    assert n == 1024 // 32
+
+    # batch generator mode: user yields ready numpy batches
+    loader2 = fluid.DataLoader.from_generator(feed_list=[img, label],
+                                              capacity=2)
+
+    def batches():
+        for _ in range(3):
+            yield np.zeros((16, 784), np.float32), np.zeros((16, 1), np.int64)
+
+    loader2.set_batch_generator(batches)
+    assert sum(1 for _ in loader2) == 3
+
+
+def test_dataloader_prefetch_overlaps_producer_and_consumer():
+    """With capacity>=2 the generator runs ahead while the consumer works:
+    wall clock ~ max(gen, consume), not the sum (BufferedReader's point)."""
+    main, startup, img, label, _ = _mnist_mlp()
+    loader = fluid.DataLoader.from_generator(feed_list=[img, label],
+                                             capacity=4)
+    n, gen_s, use_s = 12, 0.03, 0.03
+
+    def slow_batches():
+        for _ in range(n):
+            time.sleep(gen_s)
+            yield np.zeros((8, 784), np.float32), np.zeros((8, 1), np.int64)
+
+    loader.set_batch_generator(slow_batches)
+    t0 = time.perf_counter()
+    for _ in loader:
+        time.sleep(use_s)
+    dt = time.perf_counter() - t0
+    serial = n * (gen_s + use_s)
+    assert dt < serial * 0.8, f"no overlap: {dt:.3f}s vs serial {serial:.3f}s"
+
+
+def test_dataloader_propagates_generator_errors():
+    import pytest
+
+    main, startup, img, label, _ = _mnist_mlp()
+    loader = fluid.DataLoader.from_generator(feed_list=[img, label],
+                                             capacity=2)
+
+    def bad():
+        yield np.zeros((4, 784), np.float32), np.zeros((4, 1), np.int64)
+        raise RuntimeError("boom in generator")
+
+    loader.set_batch_generator(bad)
+    with pytest.raises(RuntimeError, match="boom in generator"):
+        for _ in loader:
+            pass
+
+
+def test_pyreader_start_next_api():
+    main, startup, img, label, _ = _mnist_mlp()
+    reader = fluid.PyReader(feed_list=[img, label], capacity=2)
+    reader.decorate_sample_generator(fluid.dataset.mnist.test(),
+                                     batch_size=128)
+    reader.start()
+    feed = reader.next()
+    assert feed["img"].shape == (128, 784)
+
+
+def test_data_feeder():
+    main, startup, img, label, _ = _mnist_mlp()
+    feeder = fluid.DataFeeder(feed_list=[img, label], place=fluid.CPUPlace())
+    samples = list(fluid.dataset.mnist.test()())[:16]
+    fd = feeder.feed(samples)
+    assert fd["img"].shape == (16, 784) and fd["img"].dtype == np.float32
+    assert fd["label"].shape == (16, 1) and fd["label"].dtype == np.int64
+
+
+def test_datasets_shapes():
+    x, y = next(iter(fluid.dataset.cifar.train10()()))
+    assert x.shape == (3072,) and x.dtype == np.float32
+    xs, price = next(iter(fluid.dataset.uci_housing.train()()))
+    assert xs.shape == (13,) and price.shape == (1,)
+    words, sent = next(iter(fluid.dataset.imdb.train()()))
+    assert isinstance(words, list) and sent in (0, 1)
+    assert len(fluid.dataset.imdb.word_dict()) > 5000
+
+
+def test_imdb_signal_is_learnable():
+    """The synthetic fallback plants a band signal: a mean-embedding bag of
+    words model must beat chance comfortably."""
+    import collections
+
+    docs = list(fluid.dataset.imdb.train()())[:512]
+    half = 5149 // 2
+    correct = 0
+    for words, label in docs:
+        frac_low = np.mean([w < half for w in words])
+        correct += int((frac_low > 0.5) == (label == 1))
+    assert correct / len(docs) > 0.9
