@@ -142,24 +142,23 @@ class OptimizerWithMixedPrecision:
         from ...layers.control_flow import _block_io
 
         program = params_grads[0][0].block.program
-        role_guard = program._op_role_guard("optimize")
-        role_guard.__enter__()
-        parent = program.current_block()
-        notinf = parent.create_var(
-            name=unique_name.generate("amp_grads_finite"), shape=(1,),
-            dtype="bool", stop_gradient=True)
-        parent.append_op("logical_not", inputs={"X": self._found_inf.name},
-                         outputs={"Out": notinf.name})
-        sub = program._create_block()
-        try:
-            optimize_ops = self._optimizer.apply_gradients(params_grads)
-        finally:
-            program._rollback()
-        reads, writes = _block_io(sub, parent)
-        parent.append_op("conditional_block",
-                         inputs={"Cond": [notinf.name], "Input": reads},
-                         outputs={"Out": writes},
-                         attrs={"sub_block": sub.idx})
+        with program._op_role_guard("optimize"):
+            parent = program.current_block()
+            notinf = parent.create_var(
+                name=unique_name.generate("amp_grads_finite"), shape=(1,),
+                dtype="bool", stop_gradient=True)
+            parent.append_op("logical_not", inputs={"X": self._found_inf.name},
+                             outputs={"Out": notinf.name})
+            sub = program._create_block()
+            try:
+                optimize_ops = self._optimizer.apply_gradients(params_grads)
+            finally:
+                program._rollback()
+            reads, writes = _block_io(sub, parent)
+            parent.append_op("conditional_block",
+                             inputs={"Cond": [notinf.name], "Input": reads},
+                             outputs={"Out": writes},
+                             attrs={"sub_block": sub.idx})
         return optimize_ops
 
     def apply_optimize(self, loss, startup_program, params_grads):

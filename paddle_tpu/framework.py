@@ -473,10 +473,6 @@ class Program:
         p = Program.from_dict(self.to_dict())
         p._uid_counter = self._uid_counter
         p.random_seed = self.random_seed
-        # the AMP compute policy is program state, not op metadata: carry it
-        # so eval clones of a decorated program also run bf16
-        if getattr(self, "_amp_policy", None) is not None:
-            p._amp_policy = self._amp_policy
         if for_test:
             # prune the backward/optimize/lr-sched parts (reference
             # core.prune_backward called from clone framework.py:3571):

@@ -83,6 +83,9 @@ class Optimizer:
         var = main_block.create_var(name=var_name, shape=tuple(shape),
                                     dtype=dtype, persistable=True,
                                     stop_gradient=True)
+        # marks the var as shardable optimizer state for ZeRO-1
+        # (BuildStrategy.ReduceStrategy.Reduce; ref build_strategy.h:58 kReduce)
+        var.is_optimizer_state = True
         startup = default_startup_program().global_block
         startup.create_var(name=var_name, shape=tuple(shape), dtype=dtype,
                            persistable=True)

@@ -127,6 +127,7 @@ class CompiledProgram:
         multiproc = jax.process_count() > 1
         batch_shard = NamedSharding(self._mesh, P("dp"))
         repl = NamedSharding(self._mesh, P())
+        state_shardings = getattr(step, "state_shardings", {})
         if multiproc:
             # each trainer feeds its LOCAL batch shard; together they form
             # the global batch (the reference's FeedAndSplitTensorIntoLocal
@@ -145,7 +146,7 @@ class CompiledProgram:
                 if v is None:
                     raise RuntimeError(f"Variable '{n}' not initialized in scope")
                 if multiproc:
-                    v = _ensure_global(v, repl)
+                    v = _ensure_global(v, state_shardings.get(n, repl))
                 vals.append(v)
             return vals
 
@@ -183,20 +184,45 @@ class CompiledProgram:
 
         batch_spec = NamedSharding(mesh, P("dp"))
         repl_spec = NamedSharding(mesh, P())
+
+        # ZeRO-1 (BuildStrategy.ReduceStrategy.Reduce, ref build_strategy.h:58
+        # kReduce / multi_devices_graph_pass.h:157 ReduceSSAGraphBuilder):
+        # optimizer-state vars are sharded over the dp axis on dim 0. GSPMD
+        # then partitions the update elementwise — grads reach each shard as
+        # a reduce-scatter and fresh params are all-gathered, which is exactly
+        # the reduce+broadcast the reference builder inserts by hand.
+        zero1 = self._build_strategy.reduce_strategy == ReduceStrategy.Reduce
+        dp = mesh.shape.get("dp", 1)
+
+        def state_sharding(name):
+            if not zero1 or dp <= 1:
+                return repl_spec
+            v = block.var(name) if block.has_var(name) else None
+            if (v is not None and getattr(v, "is_optimizer_state", False)
+                    and v.shape and len(v.shape) >= 1
+                    and v.shape[0] >= dp and v.shape[0] % dp == 0):
+                return NamedSharding(mesh, P(*(["dp"] + [None] * (len(v.shape) - 1))))
+            return repl_spec
+
+        state_shardings = {n: state_sharding(n)
+                           for n in set(io["state_in"]) | set(io["state_out"])}
         in_shardings = (
             [batch_spec] * len(io["feed_order"]),
-            [repl_spec] * len(io["donated"]),
-            [repl_spec] * len(io["ro"]),
+            [state_shardings[n] for n in io["donated"]],
+            [state_shardings[n] for n in io["ro"]],
             None,
         )
-        # fetches + state pinned replicated so multi-process fetch reads one
-        # addressable shard and state stays valid as a next-step input
+        # fetches pinned replicated so multi-process fetch reads one
+        # addressable shard; state keeps its (possibly dp-sharded) layout so
+        # it stays valid as a next-step input
         out_shardings = (
             [repl_spec] * len(fetch_names),
-            [repl_spec] * len(io["state_out"]),
+            [state_shardings[n] for n in io["state_out"]],
         )
         jitted = jax.jit(step_fn, donate_argnums=(1,),
                          in_shardings=in_shardings,
                          out_shardings=out_shardings)
-        return _CompiledStep(jitted, io["feed_order"], io["donated"],
+        step = _CompiledStep(jitted, io["feed_order"], io["donated"],
                              io["ro"], io["state_out"], tuple(fetch_names))
+        step.state_shardings = state_shardings
+        return step

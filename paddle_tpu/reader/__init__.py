@@ -72,9 +72,8 @@ class DataLoader:
         self._return_list = return_list
         self._places = None
         self._batch_reader: Optional[Callable] = None
-        # non-iterable (start/reset) mode state
-        self._thread = None
-        self._queue: Optional[queue.Queue] = None
+        # non-iterable (start/reset/next) mode: the live epoch iterator
+        self._iter = None
 
     # -- construction (reference DataLoader.from_generator) ---------------
     @staticmethod
@@ -88,18 +87,8 @@ class DataLoader:
                              places=None):
         """reader yields ONE sample (tuple of arrays); loader batches."""
 
-        def batch_reader():
-            buf = []
-            for sample in reader():
-                buf.append(sample if isinstance(sample, (list, tuple))
-                           else (sample,))
-                if len(buf) == batch_size:
-                    yield _stack_samples(buf)
-                    buf = []
-            if buf and not drop_last:
-                yield _stack_samples(buf)
-
-        return self.set_batch_generator(batch_reader, places)
+        return self.set_sample_list_generator(
+            batch(reader, batch_size, drop_last=drop_last), places)
 
     def set_sample_list_generator(self, reader, places=None):
         """reader yields a LIST of samples per iteration (a batch)."""
@@ -198,10 +187,17 @@ class DataLoader:
         self._iter = iter(self)
 
     def next(self):
+        if self._iter is None:
+            raise RuntimeError(
+                "DataLoader.next() called without an active epoch — call "
+                "start() first (after reset(), start() begins a new epoch)")
         return next(self._iter)
 
     def reset(self):
+        it = self._iter
         self._iter = None
+        if it is not None:
+            it.close()  # unwind the generator's finally: stop the worker
 
 
 class PyReader(DataLoader):

@@ -37,12 +37,18 @@ def main():
         h = fluid.layers.fc(x, 32, act="relu", name="d_fc1")
         pred = fluid.layers.fc(h, 1, name="d_fc2")
         loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
-        fluid.optimizer.SGD(learning_rate=0.02).minimize(loss)
+        if os.getenv("DIST_OPT") == "adam":
+            fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+        else:
+            fluid.optimizer.SGD(learning_rate=0.02).minimize(loss)
 
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
+    bs = fluid.BuildStrategy()
+    if os.getenv("DIST_REDUCE") == "1":
+        bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
     compiled = fluid.CompiledProgram(main_p).with_data_parallel(
-        loss_name=loss.name)
+        loss_name=loss.name, build_strategy=bs)
 
     local = GLOBAL_BATCH // nranks
     rng = np.random.RandomState(42)

@@ -51,6 +51,27 @@ def test_two_process_loss_equality():
     assert base[-1] < base[0]
 
 
+def test_two_process_zero1_loss_equality():
+    """ZeRO-1 under the launcher: 2 processes, Adam state sharded over the
+    cross-process dp mesh, must match the single-process AllReduce curve."""
+    env = _clean_env()
+    env["DIST_OPT"] = "adam"
+    single = subprocess.run([sys.executable, "-u", RUNNER], env=env,
+                            capture_output=True, text=True, timeout=600)
+    assert single.returncode == 0, single.stdout + single.stderr
+    base = _parse_losses(single.stdout)
+
+    env["DIST_REDUCE"] = "1"
+    dist = subprocess.run(
+        [sys.executable, "-u", "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--backend", "cpu",
+         "--local_devices", "1", RUNNER],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert dist.returncode == 0, dist.stdout + dist.stderr
+    got = _parse_losses(dist.stdout)
+    np.testing.assert_allclose(got, base, rtol=2e-4, atol=1e-5)
+
+
 def test_launcher_propagates_failure():
     env = _clean_env()
     bad = os.path.join(REPO, "tests", "conftest.py")  # not a runnable trainer
