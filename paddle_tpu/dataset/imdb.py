@@ -50,6 +50,8 @@ def word_dict():
         kept = sorted((w for w, c in freq.items() if c >= _CUTOFF),
                       key=lambda w: (-freq[w], w))
         _real_dict = {w: i for i, w in enumerate(kept)}
+        # reference appends '<unk>' = len(words) so unknown ids stay in range
+        _real_dict["<unk>"] = len(_real_dict)
     return _real_dict
 
 
@@ -61,7 +63,7 @@ def _reader(split: str, wd=None):
     def reader():
         if path:
             d = wd if wd is not None else word_dict()
-            unk = len(d)
+            unk = d.get("<unk>", len(d) - 1)
             pat = re.compile(rf"aclImdb/{split}/(pos|neg)/.*\.txt$")
             with tarfile.open(path, "r:gz") as tar:
                 for member in tar.getmembers():

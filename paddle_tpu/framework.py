@@ -157,7 +157,7 @@ class Variable:
 
     # -- serialization ---------------------------------------------------
     def to_dict(self) -> dict:
-        return {
+        d = {
             "name": self.name,
             "shape": list(self.shape) if self.shape is not None else None,
             "dtype": self.dtype,
@@ -167,10 +167,13 @@ class Variable:
             "stop_gradient": self.stop_gradient,
             "is_data": self.is_data,
         }
+        if getattr(self, "is_optimizer_state", False):
+            d["is_optimizer_state"] = True  # ZeRO-1 sharding survives clone
+        return d
 
     @staticmethod
     def from_dict(block: "Block", d: dict) -> "Variable":
-        return Variable(
+        v = Variable(
             block,
             name=d["name"],
             shape=d["shape"],
@@ -181,6 +184,9 @@ class Variable:
             stop_gradient=d.get("stop_gradient", False),
             is_data=d.get("is_data", False),
         )
+        if d.get("is_optimizer_state"):
+            v.is_optimizer_state = True
+        return v
 
 
 class Parameter(Variable):

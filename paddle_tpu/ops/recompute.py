@@ -1,0 +1,189 @@
+"""Gradient checkpointing (recompute) as a program transform + one raw op.
+
+Reference: python/paddle/fluid/optimizer.py:3074 RecomputeOptimizer and
+backward.py:555 _append_backward_ops_with_checkpoints_ — the reference
+re-emits each forward segment's ops inside the backward pass so activations
+between user checkpoints are freed and rebuilt.
+
+TPU-native design: the segment becomes ONE ``recompute_segment`` op holding
+the original forward ops in a sub-block. Its lowering runs the sub-block
+under ``jax.vjp(jax.checkpoint(seg_fn), ...)``:
+
+* residuals saved across the fwd→bwd gap are exactly the segment INPUTS
+  (checkpoint tensors + params) — jax.checkpoint marks every internal value
+  as recompute-on-backward, and emits the recompute behind an optimization
+  barrier so XLA CSE cannot merge it back with the forward pass (the failure
+  mode of naive op-duplication remat);
+* the vjp closure is handed to the matching ``recompute_segment_grad`` op
+  through the trace environment — both ops lower inside the same jit trace,
+  so the linearization is shared and the forward is never computed twice at
+  trace level.
+
+RNG-consuming ops (dropout) replay bit-identically: jax.checkpoint re-traces
+the same function, and every op's PRNG key is derived from its stable
+``__uid__`` (lowering.LowerCtx.rng).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import IOSpec, register_op
+from ..lowering import EMPTY_VAR_NAME, lower_block
+
+__all__ = ["insert_recompute_segments"]
+
+
+def _is_inexact(v) -> bool:
+    return v is not None and jnp.issubdtype(jnp.result_type(v), jnp.inexact)
+
+
+def _vjp_key(uid: int) -> str:
+    return f"__recompute_vjp_{uid}__"
+
+
+def _recompute_segment_lower(ctx, op, env):
+    sub = ctx.program.blocks[op.attrs["sub_block"]]
+    in_names = op.inputs.get("Input", [])
+    out_names = op.outputs.get("Out", [])
+    diff = [n for n in in_names if _is_inexact(env.get(n))]
+
+    def seg_fn(diff_vals):
+        benv = dict(env)
+        benv.update(zip(diff, diff_vals))
+        lower_block(sub, benv, ctx)
+        return tuple(benv[n] for n in out_names)
+
+    primals = tuple(env[n] for n in diff)
+    outs, vjp_fn = jax.vjp(jax.checkpoint(seg_fn), primals)
+    for n, v in zip(out_names, outs):
+        env[n] = v
+    # hand the shared linearization to the grad op (same trace); keyed by the
+    # forward op's uid, which the grad op carries as __fwd_uid__
+    env[_vjp_key(op.attrs.get("__uid__", 0))] = (vjp_fn, diff, outs)
+
+
+def _recompute_segment_grad_lower(ctx, op, env):
+    entry = env.get(_vjp_key(op.attrs.get("__fwd_uid__", 0)))
+    if entry is None:
+        raise RuntimeError(
+            "recompute_segment_grad lowered without its forward op in the "
+            "same trace — the program was cut between forward and backward")
+    vjp_fn, diff, fwd_outs = entry
+    grad_in = op.inputs.get("Out@GRAD", [])
+    cts = []
+    for i, val in enumerate(fwd_outs):
+        if not _is_inexact(val):
+            # integer/bool segment outputs take float0 cotangents per vjp
+            cts.append(jnp.zeros(jnp.shape(val), jax.dtypes.float0))
+            continue
+        g = env.get(grad_in[i]) if (i < len(grad_in)
+                                    and grad_in[i] != EMPTY_VAR_NAME) else None
+        if g is None:
+            g = jnp.zeros_like(val)
+        else:
+            g = g.astype(val.dtype).reshape(val.shape)
+        cts.append(g)
+    (grads,) = vjp_fn(tuple(cts))
+    grad_map = dict(zip(diff, grads))
+    in_names = op.inputs.get("Input", [])
+    for n, gname in zip(in_names, op.outputs.get("Input@GRAD", [])):
+        if gname == EMPTY_VAR_NAME:
+            continue
+        g = grad_map.get(n)
+        if g is not None:
+            env[gname] = g
+
+
+register_op("recompute_segment",
+            inputs=[IOSpec("Input", duplicable=True, optional=True)],
+            outputs=[IOSpec("Out", duplicable=True)],
+            attrs={"sub_block": None},
+            grad="auto", grad_lower=_recompute_segment_grad_lower, raw=True,
+            infer_shape=lambda op, block: None)(_recompute_segment_lower)
+
+
+def insert_recompute_segments(loss, checkpoints) -> int:
+    """Rewrite ``loss``'s block: forward ops up to each checkpoint collapse
+    into ``recompute_segment`` ops. Returns the number of segments created.
+
+    Must run BEFORE append_backward (RecomputeOptimizer.backward does). Vars
+    internal to a segment are demoted to sub-block locals — they no longer
+    exist between forward and backward, which is the entire point; fetching
+    them from user code stops working (same trade the reference makes).
+    """
+    block = loss.block
+    program = block.program
+    ckpt_names = {c.name if hasattr(c, "name") else c for c in checkpoints}
+
+    ops = list(block.ops)
+    producer = {}
+    for i, o in enumerate(ops):
+        for n in o.output_arg_names:
+            if n in ckpt_names:
+                producer[n] = i
+    cuts = sorted({producer[n] for n in ckpt_names if n in producer})
+    if not cuts:
+        return 0
+
+    # names read after index i (suffix union), plus names that must survive:
+    # checkpoints themselves, persistables, the loss
+    keep_always = set(ckpt_names) | {loss.name}
+    suffix_reads: List[set] = [set() for _ in range(len(ops) + 1)]
+    for i in range(len(ops) - 1, -1, -1):
+        suffix_reads[i] = suffix_reads[i + 1] | {
+            n for n in ops[i].input_arg_names if n != EMPTY_VAR_NAME}
+
+    new_ops: List = []
+    start = 0
+    n_segments = 0
+    for cut in cuts:
+        seg = ops[start:cut + 1]
+        rest_reads = suffix_reads[cut + 1]
+        if len(seg) <= 1:
+            # a 1-op segment saves nothing; leave it inline
+            new_ops.extend(seg)
+            start = cut + 1
+            continue
+        produced: List[str] = []
+        for o in seg:
+            for n in o.output_arg_names:
+                if n != EMPTY_VAR_NAME and n not in produced:
+                    produced.append(n)
+        reads: List[str] = []
+        produced_set = set(produced)
+        for o in seg:
+            for n in o.input_arg_names:
+                if (n != EMPTY_VAR_NAME and n not in produced_set
+                        and n not in reads):
+                    reads.append(n)
+        outs = [n for n in produced
+                if n in rest_reads or n in keep_always
+                or (block.has_var(n) and block.var(n).persistable)]
+
+        sub = program._create_block(parent_idx=block.idx)
+        program._rollback()
+        for o in seg:
+            o.block = sub
+        sub.ops = seg
+        # demote internals to sub-block locals so _block_io-style analyses
+        # and the executor's liveness never see them at the parent level
+        for n in produced:
+            if n not in outs and block.has_var(n):
+                sub.vars[n] = block.vars.pop(n)
+
+        from ..framework import Operator
+
+        seg_op = Operator(block, "recompute_segment",
+                          inputs={"Input": reads}, outputs={"Out": outs},
+                          attrs={"sub_block": sub.idx})
+        block._stamp(seg_op)  # stable __uid__ + op-role
+        new_ops.append(seg_op)
+        n_segments += 1
+        start = cut + 1
+    new_ops.extend(ops[start:])
+    block.ops = new_ops
+    program._bump_version()
+    return n_segments

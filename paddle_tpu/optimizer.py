@@ -730,26 +730,45 @@ class LookaheadOptimizer:
 
 
 class RecomputeOptimizer:
-    """reference optimizer.py:3074. On TPU the memory lever is
-    jax.checkpoint over segments; at the program level we accept the
-    checkpoints list for API parity and rely on XLA rematerialisation
-    (a segment-level jax.checkpoint pass is tracked for the trainer path)."""
+    """Gradient checkpointing (reference optimizer.py:3074 RecomputeOptimizer,
+    backward.py:555 _append_backward_ops_with_checkpoints_).
+
+    Before the backward is appended, forward ops up to each user checkpoint
+    collapse into ``recompute_segment`` ops lowered under jax.checkpoint —
+    activations between checkpoints are never saved across the fwd/bwd gap;
+    the backward rebuilds them from the checkpoint tensors (see
+    ops/recompute.py for the trade against the reference's op-duplication)."""
 
     def __init__(self, optimizer):
         self._optimizer = optimizer
         self._checkpoints = None
 
     def _set_checkpoints(self, checkpoints):
-        self._checkpoints = checkpoints
+        if not isinstance(checkpoints, (list, tuple)):
+            raise TypeError("checkpoints must be a list of Variables/names")
+        self._checkpoints = list(checkpoints)
 
-    def backward(self, loss, **kw):
-        return self._optimizer.backward(loss, **kw)
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        from .ops.recompute import insert_recompute_segments
+
+        if self._checkpoints:
+            insert_recompute_segments(loss, self._checkpoints)
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        callbacks)
 
     def apply_gradients(self, params_grads):
         return self._optimizer.apply_gradients(params_grads)
 
-    def minimize(self, loss, **kw):
-        return self._optimizer.minimize(loss, **kw)
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        with program_guard(program, startup_program):
+            params_grads = self.backward(loss, startup_program,
+                                         parameter_list, no_grad_set)
+            optimize_ops = self._optimizer.apply_gradients(params_grads)
+        return optimize_ops, params_grads
 
 
 # canonical short aliases (v2-style names)

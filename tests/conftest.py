@@ -16,5 +16,24 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+if os.environ.get("PADDLE_TPU_TESTS") != "1":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: needs a real accelerator; run with PADDLE_TPU_TESTS=1 "
+        "pytest -m tpu (skipped on the CPU suite)")
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    on_accel = any(d.platform != "cpu" for d in jax.devices())
+    skip = pytest.mark.skip(reason="no accelerator (set PADDLE_TPU_TESTS=1 "
+                                   "outside the forced-CPU suite)")
+    for item in items:
+        if "tpu" in item.keywords and not on_accel:
+            item.add_marker(skip)
