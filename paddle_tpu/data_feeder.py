@@ -30,8 +30,26 @@ def coerce_feed_array(var: Variable, arr: np.ndarray) -> np.ndarray:
     return arr
 
 
+def bucket_length(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n; doubles past the largest configured bucket.
+    Bounds the number of distinct padded shapes — and therefore XLA
+    recompiles — the varlen path can produce (SURVEY §5 bucketed compile
+    cache; the reference needs no buckets because LoD shapes are dynamic)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    b = buckets[-1] if buckets else 1
+    while b < n:
+        b *= 2
+    return b
+
+
+DEFAULT_SEQ_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
+
+
 class DataFeeder:
-    def __init__(self, feed_list: Sequence, place=None, program=None):
+    def __init__(self, feed_list: Sequence, place=None, program=None,
+                 seq_buckets: Sequence[int] = DEFAULT_SEQ_BUCKETS):
         self.feed_names: List[str] = []
         self.feed_vars: List[Variable] = []
         for v in feed_list:
@@ -42,11 +60,15 @@ class DataFeeder:
             self.feed_vars.append(v)
             self.feed_names.append(v.name)
         self.place = place
+        self.seq_buckets = tuple(seq_buckets)
 
     def feed(self, iterable) -> Dict[str, np.ndarray]:
         """iterable: list of sample tuples, one tuple per example, fields
         aligned with feed_list. Returns {name: batched ndarray} with dtypes
-        coerced to each variable's declared dtype."""
+        coerced to each variable's declared dtype. For lod_level>=1 vars the
+        samples are variable-length sequences: they are padded to a bucketed
+        max_len and a '<name>@LOD' int32 lengths entry is added (the padded
+        + lengths encoding consumed by the sequence ops)."""
         samples = list(iterable)
         if not samples:
             raise ValueError("empty minibatch")
@@ -58,7 +80,26 @@ class DataFeeder:
                 f"{len(self.feed_names)} ({self.feed_names})")
         out = {}
         for var, col in zip(self.feed_vars, cols):
-            arr = np.stack([np.asarray(v, dtype=np_dtype(var.dtype))
-                            for v in col])
-            out[var.name] = coerce_feed_array(var, arr)
+            if var.lod_level >= 1:
+                arr, lengths = self._pad_varlen(var, col)
+                out[var.name] = arr
+                out[var.name + "@LOD"] = lengths
+            else:
+                arr = np.stack([np.asarray(v, dtype=np_dtype(var.dtype))
+                                for v in col])
+                out[var.name] = coerce_feed_array(var, arr)
         return out
+
+    def _pad_varlen(self, var: Variable, col):
+        dt = np_dtype(var.dtype)
+        seqs = [np.asarray(v, dtype=dt) for v in col]
+        lengths = np.array([s.shape[0] for s in seqs], dtype=np.int32)
+        max_len = bucket_length(int(lengths.max()), self.seq_buckets)
+        feat = seqs[0].shape[1:]
+        arr = np.zeros((len(seqs), max_len) + feat, dtype=dt)
+        for i, s in enumerate(seqs):
+            arr[i, :s.shape[0]] = s
+        if var.shape is not None and arr.ndim == len(var.shape) - 1:
+            # token scalars fed as [.., 1] (reference LoDTensor convention)
+            arr = arr.reshape(arr.shape + (1,))
+        return arr, lengths

@@ -12,6 +12,12 @@ from .control_flow import (While, Switch, IfElse, StaticRNN,  # noqa: F401
                            create_array, tensor_array_to_tensor)
 from . import control_flow  # noqa: F401
 from . import tensor  # noqa: F401
+from .sequence import (sequence_pool, sequence_softmax,  # noqa: F401
+                       sequence_reverse, sequence_expand, sequence_concat,
+                       sequence_pad, sequence_unpad, sequence_slice,
+                       sequence_erase, sequence_enumerate, sequence_conv,
+                       sequence_first_step, sequence_last_step, sequence_mask)
+from . import sequence  # noqa: F401
 from .learning_rate_scheduler import (cosine_decay, exponential_decay,  # noqa: F401
                                       inverse_time_decay, linear_lr_warmup,
                                       natural_exp_decay, noam_decay,

@@ -350,14 +350,19 @@ class StaticRNN:
         if init is None:
             if shape is None or batch_ref is None:
                 raise ValueError("memory needs init or (shape, batch_ref)")
-            # constant init of [batch, *shape] built OUTSIDE the loop
+            # constant init of [batch, *shape] built OUTSIDE the loop;
+            # batch_ref is time-major so the batch is its dim 1 (reference
+            # StaticRNN.memory also uses fill_constant_batch_size_like,
+            # which keeps the shape inferable when batch is dynamic)
+            from .tensor import fill_constant_batch_size_like
+
             program = default_main_program()
             cur = program.current_block_idx
             program.current_block_idx = self._parent.idx
             try:
-                init = fill_constant(
-                    shape=[batch_ref.shape[1]] + list(shape), dtype=dtype,
-                    value=init_value)
+                init = fill_constant_batch_size_like(
+                    input=batch_ref, shape=[-1] + list(shape), dtype=dtype,
+                    value=init_value, input_dim_idx=1, output_dim_idx=0)
             finally:
                 program.current_block_idx = cur
         pre = self._sub.create_var(

@@ -15,10 +15,21 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
     if append_batch_size:
         if any(s == -1 for s in shape):
             append_batch_size = False
+        elif lod_level >= 1:
+            # padded+lengths encoding: reference shape is per-token, so the
+            # padded var gains BOTH a batch and a (bucketed) time dim
+            shape = [-1, -1] + shape
         else:
             shape = [-1] + shape
     block = default_main_program().current_block()
     v = block.create_var(name=name, shape=shape,
                          dtype=canonical_dtype(dtype), lod_level=lod_level,
                          stop_gradient=stop_gradient, is_data=True)
+    if lod_level >= 1:
+        # padded+lengths LoD encoding (SURVEY §5): the per-sequence lengths
+        # arrive in a companion feed '<name>@LOD' (int32 [batch]), produced
+        # by the DataFeeder/DataLoader varlen path and consumed by the
+        # sequence ops' SeqLen slots (ops/sequence_ops.py)
+        block.create_var(name=name + "@LOD", shape=(-1,), dtype="int32",
+                         stop_gradient=True, is_data=True)
     return v

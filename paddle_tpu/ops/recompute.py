@@ -128,20 +128,25 @@ def insert_recompute_segments(loss, checkpoints) -> int:
     if not cuts:
         return 0
 
-    # names read after index i (suffix union), plus names that must survive:
-    # checkpoints themselves, persistables, the loss
+    # names read after each cut index, plus names that must survive:
+    # checkpoints themselves, persistables, the loss. One reverse sweep,
+    # snapshotting the running read-set only at the cut positions.
     keep_always = set(ckpt_names) | {loss.name}
-    suffix_reads: List[set] = [set() for _ in range(len(ops) + 1)]
+    reads_after_cut = {}
+    running: set = set()
+    cut_set = set(cuts)
     for i in range(len(ops) - 1, -1, -1):
-        suffix_reads[i] = suffix_reads[i + 1] | {
-            n for n in ops[i].input_arg_names if n != EMPTY_VAR_NAME}
+        if i in cut_set:
+            reads_after_cut[i] = set(running)
+        running.update(n for n in ops[i].input_arg_names
+                       if n != EMPTY_VAR_NAME)
 
     new_ops: List = []
     start = 0
     n_segments = 0
     for cut in cuts:
         seg = ops[start:cut + 1]
-        rest_reads = suffix_reads[cut + 1]
+        rest_reads = reads_after_cut[cut]
         if len(seg) <= 1:
             # a 1-op segment saves nothing; leave it inline
             new_ops.extend(seg)

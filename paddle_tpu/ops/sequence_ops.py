@@ -1,0 +1,286 @@
+"""Sequence (LoD) ops on the padded + lengths encoding.
+
+Reference: paddle/fluid/operators/sequence_ops/ (~15 LoD-aware ops over
+packed LoDTensors, lod_tensor.h:104) — the reference stores variable-length
+batches packed with offset tables and every kernel walks the offsets.
+
+TPU-native encoding (SURVEY §5): XLA wants static shapes, so a lod_level-1
+tensor is a padded ``[batch, max_len, ...]`` array plus an int32 ``[batch]``
+lengths array living in a companion variable ``<name>@LOD`` (see
+layers/sequence.py and DataFeeder varlen handling; max_len is bucketed by
+the feeder so the compile cache stays bounded). Every op here takes the
+lengths through a ``SeqLen`` input slot, masks with
+``iota < len`` instead of walking offsets, and writes zeros at invalid
+positions so downstream ops see deterministic padding. Grads come from the
+generic jax.vjp path — masking makes padded positions' gradients zero
+automatically.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import IOSpec, out, register_op, x
+
+__all__ = []
+
+
+def _mask(lengths, max_len):
+    """[batch, max_len] bool validity mask from [batch] lengths."""
+    return jnp.arange(max_len)[None, :] < lengths[:, None]
+
+
+def _expand_mask(m, ndim):
+    """Broadcast a [batch, time] mask over trailing feature dims."""
+    return m.reshape(m.shape + (1,) * (ndim - 2))
+
+
+def _dtype_min(dt):
+    return jnp.finfo(dt).min if jnp.issubdtype(dt, jnp.inexact) \
+        else jnp.iinfo(dt).min
+
+
+def _dtype_max(dt):
+    return jnp.finfo(dt).max if jnp.issubdtype(dt, jnp.inexact) \
+        else jnp.iinfo(dt).max
+
+
+@register_op("sequence_pool",
+             inputs=[IOSpec("X"), IOSpec("SeqLen", no_grad=True)],
+             outputs=["Out", IOSpec("MaxIndex", optional=True)],
+             attrs={"pooltype": "AVERAGE", "pad_value": 0.0})
+def _sequence_pool(ctx, ins, attrs):
+    """reference sequence_pool_op.h: one pooled row per sequence."""
+    xv, ln = x(ins, "X"), x(ins, "SeqLen")
+    t = attrs["pooltype"].upper()
+    m = _expand_mask(_mask(ln, xv.shape[1]), xv.ndim)
+    lnf = jnp.maximum(ln, 1).astype(xv.dtype).reshape(
+        (-1,) + (1,) * (xv.ndim - 2))
+    if t == "SUM":
+        res = jnp.where(m, xv, 0).sum(axis=1)
+    elif t == "AVERAGE":
+        res = jnp.where(m, xv, 0).sum(axis=1) / lnf
+    elif t == "SQRT":
+        res = jnp.where(m, xv, 0).sum(axis=1) / jnp.sqrt(lnf)
+    elif t == "MAX":
+        res = jnp.where(m, xv, _dtype_min(xv.dtype)).max(axis=1)
+        res = jnp.where(ln.reshape(lnf.shape) > 0, res, attrs["pad_value"])
+    elif t == "MIN":
+        res = jnp.where(m, xv, _dtype_max(xv.dtype)).min(axis=1)
+        res = jnp.where(ln.reshape(lnf.shape) > 0, res, attrs["pad_value"])
+    elif t == "LAST":
+        idx = jnp.maximum(ln - 1, 0)
+        res = jnp.take_along_axis(
+            xv, idx.reshape((-1, 1) + (1,) * (xv.ndim - 2)), axis=1
+        ).squeeze(1)
+    elif t == "FIRST":
+        res = xv[:, 0]
+    else:
+        raise ValueError(f"sequence_pool: unknown pooltype {t}")
+    return out(res)
+
+
+@register_op("sequence_softmax",
+             inputs=[IOSpec("X"), IOSpec("SeqLen", no_grad=True)],
+             outputs=["Out"], attrs={})
+def _sequence_softmax(ctx, ins, attrs):
+    """reference sequence_softmax_op.h: softmax within each sequence;
+    padded positions get probability 0."""
+    xv, ln = x(ins, "X"), x(ins, "SeqLen")
+    m = _expand_mask(_mask(ln, xv.shape[1]), xv.ndim)
+    neg = jnp.finfo(xv.dtype).min
+    e = jax.nn.softmax(jnp.where(m, xv, neg), axis=1)
+    return out(jnp.where(m, e, 0))
+
+
+@register_op("sequence_reverse",
+             inputs=[IOSpec("X"), IOSpec("SeqLen", no_grad=True)],
+             outputs=["Y"], attrs={})
+def _sequence_reverse(ctx, ins, attrs):
+    """reference sequence_reverse_op.h: reverse each sequence's valid
+    prefix; padding stays in place."""
+    xv, ln = x(ins, "X"), x(ins, "SeqLen")
+    t = jnp.arange(xv.shape[1])[None, :]
+    src = jnp.where(t < ln[:, None], ln[:, None] - 1 - t, t)
+    return {"Y": [jnp.take_along_axis(
+        xv, src.reshape(src.shape + (1,) * (xv.ndim - 2)), axis=1)]}
+
+
+@register_op("sequence_expand",
+             inputs=[IOSpec("X"), IOSpec("Y", no_grad=True),
+                     IOSpec("SeqLen", no_grad=True)],
+             outputs=["Out"], attrs={"ref_level": -1})
+def _sequence_expand(ctx, ins, attrs):
+    """reference sequence_expand_op.h, padded analogue of the common case:
+    X has one row per sequence; each row is broadcast over Y's time steps
+    (masked by Y's lengths). The reference's general per-level expansion of
+    an X that itself has a time axis has no padded encoding here — rejected
+    loudly rather than producing a wrong-rank tensor."""
+    xv, yv, ln = x(ins, "X"), x(ins, "Y"), x(ins, "SeqLen")
+    if xv.ndim >= yv.ndim:
+        raise ValueError(
+            f"sequence_expand: X (shape {xv.shape}) must be one row per "
+            f"sequence (rank < Y's rank {yv.shape}); expanding an X with "
+            f"its own time axis is not supported in the padded encoding")
+    max_len = yv.shape[1]
+    rep = jnp.broadcast_to(xv[:, None], (xv.shape[0], max_len) + xv.shape[1:])
+    m = _expand_mask(_mask(ln, max_len), rep.ndim)
+    return out(jnp.where(m, rep, 0))
+
+
+@register_op("sequence_concat",
+             inputs=[IOSpec("X", duplicable=True),
+                     IOSpec("SeqLen", duplicable=True, no_grad=True)],
+             outputs=["Out", IOSpec("OutLen", no_grad=True)],
+             attrs={})
+def _sequence_concat(ctx, ins, attrs):
+    """reference sequence_concat_op.h: concatenate along time per sequence
+    (out length = sum of lengths), not along the padded axis."""
+    xs, lns = ins["X"], ins["SeqLen"]
+    total = sum(v.shape[1] for v in xs)
+    batch = xs[0].shape[0]
+    t = jnp.arange(total)[None, :]  # [1, total]
+    res = jnp.zeros((batch, total) + xs[0].shape[2:], xs[0].dtype)
+    offset = jnp.zeros((batch, 1), lns[0].dtype)
+    for v, ln in zip(xs, lns):
+        # positions offset <= t < offset+len come from v[t - offset]
+        local = t - offset
+        sel = (local >= 0) & (local < ln[:, None])
+        idx = jnp.clip(local, 0, v.shape[1] - 1)
+        gathered = jnp.take_along_axis(
+            v, idx.reshape(idx.shape + (1,) * (v.ndim - 2)), axis=1)
+        res = jnp.where(_expand_mask(sel, res.ndim), gathered, res)
+        offset = offset + ln[:, None]
+    return {"Out": [res], "OutLen": [sum(ln for ln in lns)]}
+
+
+@register_op("sequence_pad",
+             inputs=[IOSpec("X"), IOSpec("SeqLen", no_grad=True),
+                     IOSpec("PadValue", no_grad=True)],
+             outputs=["Out", IOSpec("Length", no_grad=True)],
+             attrs={"padded_length": -1})
+def _sequence_pad(ctx, ins, attrs):
+    """reference sequence_pad_op.h: emit the padded tensor with the pad
+    value written at invalid positions, plus the Length tensor."""
+    xv, ln, pv = x(ins, "X"), x(ins, "SeqLen"), x(ins, "PadValue")
+    plen = attrs.get("padded_length", -1)
+    if plen and plen > 0:
+        cur = xv.shape[1]
+        if plen < cur:
+            xv = xv[:, :plen]
+        elif plen > cur:
+            pad = [(0, 0), (0, plen - cur)] + [(0, 0)] * (xv.ndim - 2)
+            xv = jnp.pad(xv, pad)
+    m = _expand_mask(_mask(ln, xv.shape[1]), xv.ndim)
+    fill = pv.reshape((1,) * xv.ndim) if pv is not None else 0.0
+    return {"Out": [jnp.where(m, xv, fill)], "Length": [ln]}
+
+
+@register_op("sequence_unpad",
+             inputs=[IOSpec("X"), IOSpec("Length", no_grad=True)],
+             outputs=["Out", IOSpec("OutLen", no_grad=True)], attrs={})
+def _sequence_unpad(ctx, ins, attrs):
+    """reference sequence_unpad_op.h: padded + Length -> LoD tensor. In the
+    padded encoding this re-associates lengths and zeroes the padding."""
+    xv, ln = x(ins, "X"), x(ins, "Length")
+    ln = ln.reshape(-1).astype(jnp.int32)
+    m = _expand_mask(_mask(ln, xv.shape[1]), xv.ndim)
+    return {"Out": [jnp.where(m, xv, 0)], "OutLen": [ln]}
+
+
+@register_op("sequence_slice",
+             inputs=[IOSpec("X"), IOSpec("SeqLen", no_grad=True),
+                     IOSpec("Offset", no_grad=True),
+                     IOSpec("Length", no_grad=True)],
+             outputs=["Out", IOSpec("OutLen", no_grad=True)], attrs={})
+def _sequence_slice(ctx, ins, attrs):
+    """reference sequence_slice_op.h: per-sequence [offset, offset+length)
+    window."""
+    xv = x(ins, "X")
+    off = x(ins, "Offset").reshape(-1)
+    length = x(ins, "Length").reshape(-1)
+    t = jnp.arange(xv.shape[1])[None, :]
+    src = jnp.clip(off[:, None] + t, 0, xv.shape[1] - 1)
+    g = jnp.take_along_axis(
+        xv, src.reshape(src.shape + (1,) * (xv.ndim - 2)), axis=1)
+    m = _expand_mask(t < length[:, None], xv.ndim)
+    return {"Out": [jnp.where(m, g, 0)], "OutLen": [length.astype(jnp.int32)]}
+
+
+@register_op("sequence_erase",
+             inputs=[IOSpec("X", no_grad=True), IOSpec("SeqLen", no_grad=True)],
+             outputs=["Out", IOSpec("OutLen", no_grad=True)],
+             attrs={"tokens": []})
+def _sequence_erase(ctx, ins, attrs):
+    """reference sequence_erase_op.h: drop the listed token ids and compact
+    each sequence to the front (int ids; not differentiable)."""
+    xv, ln = x(ins, "X"), x(ins, "SeqLen")
+    tokens = jnp.asarray(list(attrs["tokens"]) or [-1 << 30], xv.dtype)
+    valid = _mask(ln, xv.shape[1])
+    keep = valid & ~jnp.isin(xv, tokens)
+    # stable compaction: kept positions sort before dropped ones
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    compacted = jnp.take_along_axis(xv, order, axis=1)
+    new_len = keep.sum(axis=1).astype(jnp.int32)
+    m = _mask(new_len, xv.shape[1])
+    return {"Out": [jnp.where(m, compacted, 0)], "OutLen": [new_len]}
+
+
+@register_op("sequence_enumerate",
+             inputs=[IOSpec("X", no_grad=True), IOSpec("SeqLen", no_grad=True)],
+             outputs=["Out"], attrs={"win_size": 2, "pad_value": 0})
+def _sequence_enumerate(ctx, ins, attrs):
+    """reference sequence_enumerate_op.h: sliding windows of ids,
+    pad_value past each sequence's end."""
+    xv, ln = x(ins, "X"), x(ins, "SeqLen")
+    win, pad = attrs["win_size"], attrs["pad_value"]
+    cols = []
+    t = jnp.arange(xv.shape[1])[None, :]
+    for k in range(win):
+        idx = jnp.clip(t + k, 0, xv.shape[1] - 1)
+        v = jnp.take_along_axis(xv, idx, axis=1)
+        cols.append(jnp.where(t + k < ln[:, None], v, pad))
+    return out(jnp.stack(cols, axis=-1))
+
+
+@register_op("sequence_conv",
+             inputs=[IOSpec("X"), IOSpec("Filter"),
+                     IOSpec("SeqLen", no_grad=True)],
+             outputs=["Out"],
+             attrs={"contextLength": 3, "contextStart": -1,
+                    "contextStride": 1})
+def _sequence_conv(ctx, ins, attrs):
+    """reference sequence_conv_op.h: im2col over the time axis within each
+    sequence then GEMM — out[t] = concat(x[t+start .. t+start+L-1]) @ W,
+    with out-of-sequence context rows zero."""
+    xv, w, ln = x(ins, "X"), x(ins, "Filter"), x(ins, "SeqLen")
+    L, start = attrs["contextLength"], attrs["contextStart"]
+    t = jnp.arange(xv.shape[1])[None, :]
+    valid = t < ln[:, None]
+    frames = []
+    for k in range(L):
+        idx = t + start + k
+        ok = (idx >= 0) & (idx < ln[:, None])
+        src = jnp.clip(idx, 0, xv.shape[1] - 1)
+        v = jnp.take_along_axis(
+            xv, src.reshape(src.shape + (1,) * (xv.ndim - 2)), axis=1)
+        frames.append(jnp.where(ok[..., None], v, 0))
+    col = jnp.concatenate(frames, axis=-1)  # [b, T, L*d]
+    res = jnp.einsum("btc,co->bto", col, w)
+    return out(jnp.where(valid[..., None], res, 0))
+
+
+@register_op("sequence_mask", inputs=[IOSpec("X", no_grad=True)],
+             outputs=["Y"], attrs={"maxlen": -1, "out_dtype": "float32"})
+def _sequence_mask(ctx, ins, attrs):
+    """reference sequence_mask_op.h: lengths -> [.., maxlen] 0/1 mask."""
+    from ..core.types import np_dtype
+
+    ln = x(ins, "X")
+    maxlen = attrs["maxlen"]
+    if maxlen is None or maxlen <= 0:
+        raise ValueError("sequence_mask on TPU needs a static maxlen attr")
+    m = jnp.arange(maxlen)[None, :] < ln.reshape(-1, 1)
+    m = m.reshape(tuple(ln.shape) + (maxlen,))
+    return {"Y": [m.astype(np_dtype(attrs["out_dtype"]))]}

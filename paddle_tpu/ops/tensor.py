@@ -263,7 +263,9 @@ def _lookup_table(ctx, ins, attrs):
     squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
     if squeeze_last:
         ids = jnp.squeeze(ids, -1)
-    emb = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    # mode="clip" = XLA/TPU gather OOB semantics; jnp's default "fill" turns
+    # an oversized id into silent NaNs (the reference bounds-checks on CPU)
+    emb = jnp.take(w, ids.astype(jnp.int32), axis=0, mode="clip")
     pad = attrs.get("padding_idx", -1)
     if pad is not None and pad != -1:
         mask = (ids != pad)[..., None]

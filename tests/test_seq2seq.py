@@ -95,3 +95,74 @@ def test_beam_search_beams_diverge():
         if len(hyps) > 1:
             diverged += 1
     assert diverged >= 2, f"beams collapsed to greedy: {diverged}/4 diverged"
+
+
+def test_seq2seq_varlen_trains_across_buckets():
+    """Genuinely variable-length batches (VERDICT r2 item 4 done-criterion):
+    copy task with lengths 3..12, masked loss, DataFeeder bucketing; batches
+    land in two buckets (8, 16) -> exactly two compiled train steps."""
+    from paddle_tpu.models.seq2seq import build_seq2seq_train_varlen
+
+    import paddle_tpu.unique_name as un
+
+    rng = np.random.RandomState(5)
+    with un.guard():
+        m = build_seq2seq_train_varlen(VOCAB, VOCAB, emb_dim=16, hidden=32,
+                                       lr=1e-2)
+    m["main"].random_seed = 13
+    feeder = fluid.DataFeeder(feed_list=m["feed_vars"], program=m["main"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+
+    def make_batch(lo, hi, n=8):
+        samples = []
+        for _ in range(n):
+            L = int(rng.randint(lo, hi + 1))
+            s = rng.randint(2, VOCAB, L).astype(np.int64)
+            tin = np.concatenate([[0], s[:-1]])
+            samples.append((s, tin, s))
+        return feeder.feed(samples)
+
+    batches = [make_batch(3, 8), make_batch(9, 12)]  # buckets 8 and 16
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(m["startup"])
+        for step in range(60):
+            (lv,) = exe.run(m["main"], feed=batches[step % 2],
+                            fetch_list=[m["loss"].name])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.5, losses[::12]
+    # startup + one executable per bucket (8 and 16)
+    assert len(exe._cache) == 3, f"got {len(exe._cache)} cache entries"
+
+
+def test_varlen_loss_ignores_padding():
+    """The same logical batch padded to different max_lens must give the
+    same loss (padding contributes nothing) — the padded-vs-packed
+    equivalence at model level."""
+    from paddle_tpu.models.seq2seq import build_seq2seq_train_varlen
+
+    import paddle_tpu.unique_name as un
+
+    rng = np.random.RandomState(9)
+    samples = []
+    for _ in range(6):
+        L = int(rng.randint(3, 8))
+        s = rng.randint(2, VOCAB, L).astype(np.int64)
+        samples.append((s, np.concatenate([[0], s[:-1]]), s))
+    losses = {}
+    for buckets in [(8,), (32,)]:
+        with un.guard():
+            m = build_seq2seq_train_varlen(VOCAB, VOCAB, emb_dim=16,
+                                           hidden=32)
+        m["main"].random_seed = 21
+        feeder = fluid.DataFeeder(feed_list=m["feed_vars"],
+                                  program=m["main"], seq_buckets=buckets)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(m["startup"])
+            (lv,) = exe.run(m["main"], feed=feeder.feed(samples),
+                            fetch_list=[m["loss"].name])
+        losses[buckets[0]] = float(np.asarray(lv).reshape(-1)[0])
+    np.testing.assert_allclose(losses[8], losses[32], rtol=1e-5)

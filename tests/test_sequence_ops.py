@@ -1,0 +1,314 @@
+"""Sequence-op correctness on the padded+lengths LoD encoding (reference
+operators/sequence_ops/ tests built on OpTest; oracles computed per-sequence
+on the PACKED representation, so these double as padded-vs-packed
+equivalence checks)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+RNG = np.random.RandomState(7)
+LENS = np.array([5, 1, 8, 3], np.int32)
+MAXLEN = 8
+
+
+def _padded(feat=(4,), lens=LENS, maxlen=MAXLEN, rng=RNG):
+    x = np.zeros((len(lens), maxlen) + feat, np.float32)
+    packed = []
+    for i, L in enumerate(lens):
+        s = rng.randn(L, *feat).astype(np.float32)
+        x[i, :L] = s
+        packed.append(s)
+    return x, packed
+
+
+class TestSequencePoolSum(OpTest):
+    pooltype = "SUM"
+
+    def _oracle(self, packed):
+        return np.stack([{
+            "SUM": s.sum(0),
+            "AVERAGE": s.mean(0),
+            "SQRT": s.sum(0) / np.sqrt(s.shape[0]),
+            "MAX": s.max(0),
+            "LAST": s[-1],
+            "FIRST": s[0],
+        }[self.pooltype] for s in packed])
+
+    def setup(self):
+        x, packed = _padded()
+        self.op_type = "sequence_pool"
+        self.inputs = {"X": x, "SeqLen": LENS}
+        self.attrs = {"pooltype": self.pooltype}
+        self.outputs = {"Out": self._oracle(packed)}
+
+    def test(self):
+        self.check_output(rtol=1e-5, atol=1e-5)
+        self.check_grad(["X"], "Out")
+
+
+class TestSequencePoolAverage(TestSequencePoolSum):
+    pooltype = "AVERAGE"
+
+
+class TestSequencePoolSqrt(TestSequencePoolSum):
+    pooltype = "SQRT"
+
+
+class TestSequencePoolMax(TestSequencePoolSum):
+    pooltype = "MAX"
+
+
+class TestSequencePoolLast(TestSequencePoolSum):
+    pooltype = "LAST"
+
+
+class TestSequencePoolFirst(TestSequencePoolSum):
+    pooltype = "FIRST"
+
+
+class TestSequenceSoftmax(OpTest):
+    def setup(self):
+        x, packed = _padded(feat=())
+        want = np.zeros_like(x)
+        for i, s in enumerate(packed):
+            e = np.exp(s - s.max())
+            want[i, :len(s)] = e / e.sum()
+        self.op_type = "sequence_softmax"
+        self.inputs = {"X": x, "SeqLen": LENS}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.check_output(rtol=1e-5, atol=1e-6)
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestSequenceReverse(OpTest):
+    def setup(self):
+        x, packed = _padded(feat=(3,))
+        want = x.copy()
+        for i, s in enumerate(packed):
+            want[i, :len(s)] = s[::-1]
+        self.op_type = "sequence_reverse"
+        self.inputs = {"X": x, "SeqLen": LENS}
+        self.outputs = {"Y": want}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Y")
+
+
+class TestSequenceExpand(OpTest):
+    def setup(self):
+        xrow = RNG.randn(4, 4).astype(np.float32)
+        y, _ = _padded(feat=(2,))
+        want = np.zeros((4, MAXLEN, 4), np.float32)
+        for i, L in enumerate(LENS):
+            want[i, :L] = xrow[i]
+        self.op_type = "sequence_expand"
+        self.inputs = {"X": xrow, "Y": y, "SeqLen": LENS}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestSequenceConcat(OpTest):
+    def setup(self):
+        a, pa = _padded(feat=(2,))
+        lens_b = np.array([2, 4, 1, 3], np.int32)
+        b, pb = _padded(feat=(2,), lens=lens_b, maxlen=4)
+        total = MAXLEN + 4
+        want = np.zeros((4, total, 2), np.float32)
+        out_len = LENS + lens_b
+        for i in range(4):
+            cat = np.concatenate([pa[i], pb[i]], 0)
+            want[i, :len(cat)] = cat
+        self.op_type = "sequence_concat"
+        self.inputs = {"X": [("xa", a), ("xb", b)],
+                       "SeqLen": [("la", LENS), ("lb", lens_b)]}
+        self.outputs = {"Out": want, "OutLen": out_len.astype(np.int32)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestSequencePad(OpTest):
+    def setup(self):
+        x, packed = _padded(feat=(2,))
+        pad = np.array(-1.0, np.float32)
+        want = np.full((4, MAXLEN, 2), -1.0, np.float32)
+        for i, s in enumerate(packed):
+            want[i, :len(s)] = s
+        self.op_type = "sequence_pad"
+        self.inputs = {"X": x, "SeqLen": LENS, "PadValue": pad}
+        self.attrs = {"padded_length": -1}
+        self.outputs = {"Out": want, "Length": LENS}
+
+    def test(self):
+        self.check_output()
+
+
+class TestSequenceUnpad(OpTest):
+    def setup(self):
+        x, packed = _padded(feat=(2,))
+        x_noisy = x.copy()
+        x_noisy[:, :, :] += (np.arange(MAXLEN)[None, :, None] >=
+                             LENS[:, None, None]) * 9.0  # garbage in padding
+        self.op_type = "sequence_unpad"
+        self.inputs = {"X": x_noisy, "Length": LENS}
+        self.outputs = {"Out": x, "OutLen": LENS}
+
+    def test(self):
+        self.check_output()
+
+
+class TestSequenceSlice(OpTest):
+    def setup(self):
+        x, packed = _padded(feat=(2,))
+        off = np.array([1, 0, 2, 0], np.int64)
+        ln = np.array([3, 1, 4, 2], np.int64)
+        want = np.zeros((4, MAXLEN, 2), np.float32)
+        for i in range(4):
+            want[i, :ln[i]] = x[i, off[i]:off[i] + ln[i]]
+        self.op_type = "sequence_slice"
+        self.inputs = {"X": x, "SeqLen": LENS, "Offset": off, "Length": ln}
+        self.outputs = {"Out": want, "OutLen": ln.astype(np.int32)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestSequenceErase(OpTest):
+    def setup(self):
+        ids = np.array([[2, 1, 2, 3, 0, 0],
+                        [1, 1, 1, 0, 0, 0]], np.int64)
+        lens = np.array([4, 3], np.int32)
+        want = np.array([[2, 2, 3, 0, 0, 0],
+                         [0, 0, 0, 0, 0, 0]], np.int64)
+        self.op_type = "sequence_erase"
+        self.inputs = {"X": ids, "SeqLen": lens}
+        self.attrs = {"tokens": [1]}
+        self.outputs = {"Out": want, "OutLen": np.array([3, 0], np.int32)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestSequenceEnumerate(OpTest):
+    def setup(self):
+        ids = np.array([[1, 2, 3, 4, 0], [5, 6, 0, 0, 0]], np.int64)
+        lens = np.array([4, 2], np.int32)
+        want = np.array([[[1, 2], [2, 3], [3, 4], [4, 9], [9, 9]],
+                         [[5, 6], [6, 9], [9, 9], [9, 9], [9, 9]]], np.int64)
+        self.op_type = "sequence_enumerate"
+        self.inputs = {"X": ids, "SeqLen": lens}
+        self.attrs = {"win_size": 2, "pad_value": 9}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.check_output()
+
+
+class TestSequenceConv(OpTest):
+    def setup(self):
+        x, packed = _padded(feat=(3,))
+        w = RNG.randn(9, 5).astype(np.float32) * 0.3
+        want = np.zeros((4, MAXLEN, 5), np.float32)
+        for i, s in enumerate(packed):
+            L = len(s)
+            for t in range(L):
+                ctx = []
+                for k in range(3):
+                    j = t - 1 + k
+                    ctx.append(s[j] if 0 <= j < L else np.zeros(3, np.float32))
+                want[i, t] = np.concatenate(ctx) @ w
+        self.op_type = "sequence_conv"
+        self.inputs = {"X": x, "Filter": w, "SeqLen": LENS}
+        self.attrs = {"contextLength": 3, "contextStart": -1}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+        self.check_grad(["X", "Filter"], "Out", max_relative_error=0.01)
+
+
+class TestSequenceMask(OpTest):
+    def setup(self):
+        ln = np.array([3, 0, 5], np.int64)
+        want = (np.arange(6)[None, :] < ln[:, None]).astype(np.float32)
+        self.op_type = "sequence_mask"
+        self.inputs = {"X": ln}
+        self.attrs = {"maxlen": 6, "out_dtype": "float32"}
+        self.outputs = {"Y": want}
+
+    def test(self):
+        self.check_output()
+
+
+# ---------------------------------------------------------------------------
+# layer-level: varlen feed, bucketing, LoD inference through embedding
+# ---------------------------------------------------------------------------
+
+def test_varlen_bow_model_trains_with_bucketing():
+    """IMDB-style bag-of-words: embedding over varlen ids -> sequence_pool
+    -> fc. Lengths are inferred through the embedding op; DataFeeder pads
+    to buckets so the executor compiles once per bucket, not per batch."""
+    import paddle_tpu.unique_name as un
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            words = fluid.layers.data("words", shape=[1], dtype="int64",
+                                      lod_level=1)
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            emb = fluid.layers.embedding(words, size=[100, 16])
+            pooled = fluid.layers.sequence_pool(emb, "average")
+            logits = fluid.layers.fc(pooled, 2)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    main.random_seed = 11
+
+    feeder = fluid.DataFeeder(feed_list=[words, label], program=main)
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        samples = []
+        for _ in range(16):
+            y = int(rng.randint(0, 2))
+            L = int(rng.randint(3, 12))  # all batches land in bucket 16
+            lo, hi = (0, 50) if y else (50, 100)
+            samples.append((rng.randint(lo, hi, L), np.array([y])))
+        return feeder.feed(samples)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(30):
+            (lv,) = exe.run(main, feed=make_batch(), fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.5
+    # bucketing: every batch padded to 16 -> one compiled train step (the
+    # second cache entry is the startup program)
+    assert len(exe._cache) == 2, f"expected 2 cached steps, got {len(exe._cache)}"
+
+
+def test_bucket_length():
+    from paddle_tpu.data_feeder import DEFAULT_SEQ_BUCKETS, bucket_length
+
+    assert bucket_length(3, DEFAULT_SEQ_BUCKETS) == 8
+    assert bucket_length(8, DEFAULT_SEQ_BUCKETS) == 8
+    assert bucket_length(100, DEFAULT_SEQ_BUCKETS) == 128
+    assert bucket_length(5000, DEFAULT_SEQ_BUCKETS) == 8192
+
+
+def test_seq_len_var_error_message():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("plain", shape=[4], dtype="float32")
+        with pytest.raises(ValueError, match="lod_level=1"):
+            fluid.layers.sequence_pool(x, "sum")
