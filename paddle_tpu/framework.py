@@ -169,6 +169,8 @@ class Variable:
         }
         if getattr(self, "is_optimizer_state", False):
             d["is_optimizer_state"] = True  # ZeRO-1 sharding survives clone
+        if getattr(self, "is_distributed", False):
+            d["is_distributed"] = True  # sharded-embedding tag survives clone
         return d
 
     @staticmethod
@@ -186,6 +188,8 @@ class Variable:
         )
         if d.get("is_optimizer_state"):
             v.is_optimizer_state = True
+        if d.get("is_distributed"):
+            v.is_distributed = True
         return v
 
 
@@ -547,6 +551,8 @@ class Program:
                         regularizer=reg,
                     )
                     param.stop_gradient = vd.get("stop_gradient", False)
+                    if vd.get("is_distributed"):
+                        param.is_distributed = True
                     b.vars[vd["name"]] = param
                 else:
                     b.vars[vd["name"]] = Variable.from_dict(b, vd)

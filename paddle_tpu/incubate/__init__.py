@@ -1,0 +1,1 @@
+"""incubate namespace (reference python/paddle/fluid/incubate)."""

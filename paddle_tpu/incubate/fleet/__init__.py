@@ -1,0 +1,2 @@
+"""Fleet distributed-training API (reference incubate/fleet/)."""
+from . import base  # noqa: F401

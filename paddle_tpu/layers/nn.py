@@ -72,6 +72,13 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     w = helper.create_parameter(helper.param_attr, shape=list(size),
                                 dtype=dtype, is_bias=False,
                                 default_initializer=Xavier())
+    if is_distributed or is_sparse:
+        # the PS-table / SelectedRows replacement (SURVEY §7): tag the table
+        # so CompiledProgram row-shards it over the mesh — lookups become
+        # XLA gathers with collectives (the all-to-all design) and the grad
+        # arrives at each shard as a reduce-scatter instead of a dense
+        # allreduce (reference parameter_prefetch.cc remote lookup)
+        w.is_distributed = True
     out = helper.create_variable_for_type_inference(dtype)
     pad = -1 if padding_idx is None else (
         padding_idx if padding_idx >= 0 else size[0] + padding_idx)

@@ -86,6 +86,10 @@ class Optimizer:
         # marks the var as shardable optimizer state for ZeRO-1
         # (BuildStrategy.ReduceStrategy.Reduce; ref build_strategy.h:58 kReduce)
         var.is_optimizer_state = True
+        if (getattr(param, "is_distributed", False)
+                and list(shape[:1]) == list(param.shape[:1])):
+            # accumulators of a sharded embedding table shard with it
+            var.is_distributed = True
         startup = default_startup_program().global_block
         startup.create_var(name=var_name, shape=tuple(shape), dtype=dtype,
                            persistable=True)

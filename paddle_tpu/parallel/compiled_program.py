@@ -194,14 +194,23 @@ class CompiledProgram:
         zero1 = self._build_strategy.reduce_strategy == ReduceStrategy.Reduce
         dp = mesh.shape.get("dp", 1)
 
+        def _row_shard(v):
+            return NamedSharding(mesh, P(*(["dp"] + [None] * (len(v.shape) - 1))))
+
         def state_sharding(name):
-            if not zero1 or dp <= 1:
+            if dp <= 1:
                 return repl_spec
             v = block.var(name) if block.has_var(name) else None
-            if (v is not None and getattr(v, "is_optimizer_state", False)
-                    and v.shape and len(v.shape) >= 1
-                    and v.shape[0] >= dp and v.shape[0] % dp == 0):
-                return NamedSharding(mesh, P(*(["dp"] + [None] * (len(v.shape) - 1))))
+            if v is None or not v.shape or len(v.shape) < 1 \
+                    or v.shape[0] < dp or v.shape[0] % dp:
+                return repl_spec
+            # sharded embedding table (is_sparse/is_distributed): row-shard
+            # over the mesh regardless of reduce strategy — the PS-table
+            # replacement; its accumulators carry the same tag
+            if getattr(v, "is_distributed", False):
+                return _row_shard(v)
+            if zero1 and getattr(v, "is_optimizer_state", False):
+                return _row_shard(v)
             return repl_spec
 
         state_shardings = {n: state_sharding(n)

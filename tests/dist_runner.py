@@ -30,17 +30,34 @@ def main():
 
     import paddle_tpu as fluid
 
-    main_p, startup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main_p, startup):
-        x = fluid.layers.data("x", shape=[DIM], dtype="float32")
-        y = fluid.layers.data("y", shape=[1], dtype="float32")
-        h = fluid.layers.fc(x, 32, act="relu", name="d_fc1")
-        pred = fluid.layers.fc(h, 1, name="d_fc2")
-        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
-        if os.getenv("DIST_OPT") == "adam":
-            fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
-        else:
-            fluid.optimizer.SGD(learning_rate=0.02).minimize(loss)
+    if os.getenv("DIST_MODEL") == "deepfm":
+        from paddle_tpu.models.deepfm import build_deepfm
+
+        m = build_deepfm(vocab=64, num_fields=4, emb_dim=4, lr=0.05,
+                         sharded=True)
+        m["main"].random_seed = 31
+        main_p, startup, loss = m["main"], m["startup"], m["loss"]
+        rng = np.random.RandomState(42)
+        ids = rng.randint(0, 64, (GLOBAL_BATCH, 4)).astype(np.int64)
+        feeds = {"feat_ids": ids,
+                 "label": (ids.sum(1) % 2).astype(np.float32).reshape(-1, 1)}
+    else:
+        main_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup):
+            x = fluid.layers.data("x", shape=[DIM], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(x, 32, act="relu", name="d_fc1")
+            pred = fluid.layers.fc(h, 1, name="d_fc2")
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        rng = np.random.RandomState(42)
+        w_true = np.linspace(-1, 1, DIM).astype(np.float32).reshape(DIM, 1)
+        xb = rng.rand(GLOBAL_BATCH, DIM).astype(np.float32)
+        feeds = {"x": xb, "y": np.tanh(xb @ w_true).astype(np.float32)}
+        with fluid.program_guard(main_p, startup):
+            if os.getenv("DIST_OPT") == "adam":
+                fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+            else:
+                fluid.optimizer.SGD(learning_rate=0.02).minimize(loss)
 
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
@@ -51,15 +68,11 @@ def main():
         loss_name=loss.name, build_strategy=bs)
 
     local = GLOBAL_BATCH // nranks
-    rng = np.random.RandomState(42)
-    w_true = np.linspace(-1, 1, DIM).astype(np.float32).reshape(DIM, 1)
-    xb = rng.rand(GLOBAL_BATCH, DIM).astype(np.float32)
-    yb = np.tanh(xb @ w_true).astype(np.float32)
     losses = []
     for step in range(STEPS):
         sl = slice(rank * local, (rank + 1) * local) if nranks > 1 \
             else slice(None)
-        lv = exe.run(compiled, feed={"x": xb[sl], "y": yb[sl]},
+        lv = exe.run(compiled, feed={k: v[sl] for k, v in feeds.items()},
                      fetch_list=[loss])[0]
         losses.append(float(np.asarray(lv).reshape(-1)[0]))
     if rank == 0:

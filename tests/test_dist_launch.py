@@ -72,6 +72,28 @@ def test_two_process_zero1_loss_equality():
     np.testing.assert_allclose(got, base, rtol=2e-4, atol=1e-5)
 
 
+def test_two_process_sharded_deepfm():
+    """DeepFM with its embedding tables row-sharded across the 2-process
+    mesh must match the single-process run (the PS-table replacement under
+    real multi-process collectives)."""
+    env = _clean_env()
+    env["DIST_MODEL"] = "deepfm"
+    single = subprocess.run([sys.executable, "-u", RUNNER], env=env,
+                            capture_output=True, text=True, timeout=600)
+    assert single.returncode == 0, single.stdout + single.stderr
+    base = _parse_losses(single.stdout)
+
+    dist = subprocess.run(
+        [sys.executable, "-u", "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--backend", "cpu",
+         "--local_devices", "1", RUNNER],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert dist.returncode == 0, dist.stdout + dist.stderr
+    got = _parse_losses(dist.stdout)
+    np.testing.assert_allclose(got, base, rtol=2e-4, atol=1e-5)
+    assert base[-1] < base[0]
+
+
 def test_launcher_propagates_failure():
     env = _clean_env()
     bad = os.path.join(REPO, "tests", "conftest.py")  # not a runnable trainer

@@ -1,0 +1,115 @@
+"""Sharded embedding + fleet + DeepFM (VERDICT r2 item 5; BASELINE config #5).
+
+Correctness bar (reference test_dist_fleet_base.py pattern): the DeepFM
+model with mesh-sharded embedding tables must train to the same losses as
+the plain replicated path, and the tables must actually be sharded."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.models.deepfm import build_deepfm
+
+VOCAB = 1024
+FIELDS = 8
+
+
+def _train(sharded, compiled, steps=8, batch=32):
+    import paddle_tpu.unique_name as un
+
+    with un.guard():
+        m = build_deepfm(vocab=VOCAB, num_fields=FIELDS, emb_dim=8,
+                         lr=0.02, sharded=sharded)
+    m["main"].random_seed = 31
+    prog = m["main"]
+    if compiled:
+        prog = fluid.CompiledProgram(m["main"]).with_data_parallel(
+            loss_name=m["loss"].name)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, VOCAB, (batch, FIELDS)).astype(np.int64)
+    y = (ids.sum(1) % 2).astype(np.float32).reshape(-1, 1)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(m["startup"])
+        for _ in range(steps):
+            (lv,) = exe.run(prog, feed={"feat_ids": ids, "label": y},
+                            fetch_list=[m["loss"].name])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    return losses, scope
+
+
+def test_deepfm_sharded_matches_replicated():
+    base, _ = _train(sharded=False, compiled=True)
+    shard, scope = _train(sharded=True, compiled=True)
+    np.testing.assert_allclose(base, shard, rtol=5e-3, atol=1e-5)
+    assert base[-1] < base[0]
+    # the FM tables (and their Adam moments) must be dp-sharded in the scope
+    sharded_names = [n for n, v in scope.vars.items()
+                     if "dp" in str(getattr(v.sharding, "spec", ""))]
+    assert any(n.startswith("fm_w") for n in sharded_names), sharded_names
+    assert any(n.startswith("fm_v") for n in sharded_names), sharded_names
+    assert any("moment" in n for n in sharded_names), sharded_names
+
+
+def test_deepfm_single_device_trains():
+    losses, _ = _train(sharded=False, compiled=False, steps=20)
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_fleet_collective_api():
+    """fleet.init -> distributed_optimizer -> minimize -> run the compiled
+    program (reference incubate/fleet/collective usage), single process."""
+    from paddle_tpu.incubate.fleet.base.role_maker import PaddleCloudRoleMaker
+    from paddle_tpu.incubate.fleet.collective import DistributedStrategy, fleet
+
+    import paddle_tpu.unique_name as un
+
+    os.environ["PADDLE_TRAINER_ID"] = "0"
+    os.environ["PADDLE_TRAINERS_NUM"] = "1"
+    try:
+        fleet.init(PaddleCloudRoleMaker(is_collective=True))
+        assert fleet.is_first_worker() and fleet.worker_index() == 0
+        assert fleet.worker_num() == 1
+
+        with un.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", shape=[16], dtype="float32")
+                y = fluid.layers.data("y", shape=[1], dtype="float32")
+                pred = fluid.layers.fc(fluid.layers.fc(x, 32, act="relu"), 1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                strategy = DistributedStrategy()
+                strategy.use_sharding = True  # ZeRO via fleet
+                opt = fleet.distributed_optimizer(
+                    fluid.optimizer.Adam(learning_rate=0.05), strategy)
+                opt.minimize(loss, startup_program=startup)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(0)
+        xb = rng.randn(32, 16).astype(np.float32)
+        yb = rng.randn(32, 1).astype(np.float32)
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(15):
+                (lv,) = exe.run(fleet.main_program,
+                                feed={"x": xb, "y": yb},
+                                fetch_list=[loss.name])
+                losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        assert losses[-1] < losses[0] * 0.3
+        # use_sharding flowed through to ZeRO state sharding
+        assert any("moment" in n and
+                   "dp" in str(getattr(v.sharding, "spec", ""))
+                   for n, v in scope.vars.items() if hasattr(v, "sharding"))
+    finally:
+        os.environ.pop("PADDLE_TRAINER_ID", None)
+        os.environ.pop("PADDLE_TRAINERS_NUM", None)
+        # reset the module singleton so later tests don't inherit state
+        from paddle_tpu.incubate.fleet import collective as _c
+
+        _c.fleet = _c.Fleet()
