@@ -21,6 +21,8 @@ from .parallel import BuildStrategy, CompiledProgram, ExecutionStrategy
 from . import contrib
 from . import dataset
 from . import distributed
+from . import dygraph
+from . import incubate
 from . import io
 from . import reader
 from .data_feeder import DataFeeder

@@ -1,0 +1,11 @@
+"""Dygraph (eager) mode — reference paddle/fluid/imperative/ +
+python/paddle/fluid/dygraph/. See base.py for the tape design."""
+from .base import (VarBase, guard, to_variable, enabled,  # noqa: F401
+                   in_dygraph_mode, current_tape)
+from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from .layers import Layer  # noqa: F401
+from .layers import seed_parameters  # noqa: F401
+from .nn import (FC, BatchNorm, Conv2D, Dropout, Embedding,  # noqa: F401
+                 LayerNorm, Linear, Pool2D)
+from . import nn  # noqa: F401
+from . import ops  # noqa: F401

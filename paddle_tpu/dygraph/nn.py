@@ -1,0 +1,152 @@
+"""Dygraph layer zoo (reference dygraph/nn.py:35-2762: Conv2D, FC,
+BatchNorm, Embedding, LayerNorm, ...). Thin parameterized wrappers over the
+eager op namespace; all math lives in the shared op registry."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ops
+from .base import VarBase
+from .layers import Layer
+
+__all__ = ["FC", "Linear", "Conv2D", "BatchNorm", "Embedding", "LayerNorm",
+           "Pool2D", "Dropout"]
+
+
+class FC(Layer):
+    """reference dygraph/nn.py FC (input_dim explicit, as the later Linear)."""
+
+    def __init__(self, input_dim, size, act=None, dtype="float32",
+                 name_scope=None):
+        super().__init__(name_scope or "fc", dtype)
+        self.weight = self.create_parameter([int(input_dim), int(size)])
+        self.bias = self.create_parameter([int(size)], is_bias=True)
+        self._act = act
+
+    def forward(self, x):
+        out = ops.elementwise_add(ops.mul(x, self.weight), self.bias)
+        return getattr(ops, self._act)(out) if self._act else out
+
+
+Linear = FC
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, groups=1, act=None, use_bias=True,
+                 dtype="float32", name_scope=None):
+        super().__init__(name_scope or "conv2d", dtype)
+        k = filter_size if isinstance(filter_size, (list, tuple)) \
+            else (filter_size, filter_size)
+        fan_in = num_channels * k[0] * k[1]
+        fan_out = num_filters * k[0] * k[1]
+        limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+        from .layers import _param_rng
+
+        w = _param_rng().uniform(
+            -limit, limit,
+            (num_filters, num_channels // groups, k[0], k[1])
+        ).astype(dtype)
+        self.weight = self.create_parameter(w.shape, dtype, init=w)
+        self.bias = self.create_parameter([num_filters], is_bias=True) \
+            if use_bias else None
+        self._attrs = {"strides": [stride] * 2 if np.isscalar(stride)
+                       else list(stride),
+                       "paddings": [padding] * 2 if np.isscalar(padding)
+                       else list(padding),
+                       "groups": groups}
+        self._act = act
+
+    def forward(self, x):
+        out = ops.conv2d(x, self.weight, None, **self._attrs)
+        if self.bias is not None:
+            out = ops.elementwise_add(out, self.bias, axis=1)
+        return getattr(ops, self._act)(out) if self._act else out
+
+
+class BatchNorm(Layer):
+    """Eager batch_norm: running stats are parameters updated in place from
+    the op's MeanOut/VarianceOut outputs (the reference aliases them)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 dtype="float32", name_scope=None):
+        super().__init__(name_scope or "batch_norm", dtype)
+        self.weight = self.create_parameter([num_channels], init=1.0)
+        self.bias = self.create_parameter([num_channels], is_bias=True)
+        self._mean = self.create_parameter([num_channels], init=0.0,
+                                           stop_gradient=True)
+        self._variance = self.create_parameter([num_channels], init=1.0,
+                                               stop_gradient=True)
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._act = act
+
+    def forward(self, x):
+        y, mean_out, var_out, _, _ = ops.batch_norm(
+            x, self.weight, self.bias, self._mean, self._variance,
+            momentum=self._momentum, epsilon=self._epsilon,
+            is_test=not self.training)
+        if self.training:
+            self._mean.set_value(mean_out.value)
+            self._variance.set_value(var_out.value)
+        return getattr(ops, self._act)(y) if self._act else y
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, padding_idx=None,
+                 dtype="float32", name_scope=None):
+        super().__init__(name_scope or "embedding", dtype)
+        self.weight = self.create_parameter(list(size))
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+
+    def forward(self, ids):
+        return ops.lookup_table(self.weight, ids,
+                                padding_idx=self._padding_idx)
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-5, act=None, dtype="float32", name_scope=None):
+        super().__init__(name_scope or "layer_norm", dtype)
+        if np.isscalar(normalized_shape):
+            normalized_shape = [normalized_shape]
+        n = int(np.prod(normalized_shape))
+        self.weight = self.create_parameter([n], init=1.0) if scale else None
+        self.bias = self.create_parameter([n], is_bias=True) if shift else None
+        self._epsilon = epsilon
+        self._act = act
+
+    def forward(self, x):
+        y, _, _ = ops.layer_norm(x, self.weight, self.bias,
+                                 epsilon=self._epsilon,
+                                 begin_norm_axis=len(x.shape) - 1)
+        return getattr(ops, self._act)(y) if self._act else y
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=2, pool_type="max", pool_stride=2,
+                 pool_padding=0, global_pooling=False, name_scope=None):
+        super().__init__(name_scope or "pool2d")
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": [pool_size] * 2 if np.isscalar(pool_size)
+            else list(pool_size),
+            "strides": [pool_stride] * 2 if np.isscalar(pool_stride)
+            else list(pool_stride),
+            "paddings": [pool_padding] * 2 if np.isscalar(pool_padding)
+            else list(pool_padding),
+            "global_pooling": global_pooling,
+        }
+
+    def forward(self, x):
+        return ops.pool2d(x, **self._attrs)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, name_scope=None):
+        super().__init__(name_scope or "dropout")
+        self._p = p
+
+    def forward(self, x):
+        r = ops.dropout(x, dropout_prob=self._p, is_test=not self.training)
+        return r[0] if isinstance(r, tuple) else r  # drop the Mask output

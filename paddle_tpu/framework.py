@@ -592,7 +592,6 @@ class Program:
 
 _main_program = Program()
 _startup_program = Program()
-_dygraph_tracer = None  # set by dygraph.guard
 
 
 def default_main_program() -> Program:
@@ -642,17 +641,15 @@ def name_scope(prefix: str):
 
 
 def in_dygraph_mode() -> bool:
-    return _dygraph_tracer is not None
+    from .dygraph import base as _dy
+
+    return _dy.in_dygraph_mode()
 
 
 def _current_tracer():
-    return _dygraph_tracer
+    from .dygraph import base as _dy
 
-
-def _switch_tracer(tracer):
-    global _dygraph_tracer
-    old, _dygraph_tracer = _dygraph_tracer, tracer
-    return old
+    return _dy._tape
 
 
 def _as_list(x) -> list:

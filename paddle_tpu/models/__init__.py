@@ -7,3 +7,6 @@ MNIST MLP, ResNet-50, BERT, Transformer NMT, DeepFM CTR.
 from .mlp import build_mnist_mlp  # noqa: F401
 from .resnet import build_resnet  # noqa: F401
 from .bert import BertConfig, build_bert_pretrain  # noqa: F401
+from .deepfm import build_deepfm  # noqa: F401
+from .seq2seq import (build_seq2seq_infer, build_seq2seq_train,  # noqa: F401
+                      build_seq2seq_train_varlen)

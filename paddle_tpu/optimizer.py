@@ -38,6 +38,7 @@ class Optimizer:
         self.regularization = regularization
         self._name = name
         self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self._eager_accumulators: Dict[int, dict] = {}  # dygraph-mode state
         self._learning_rate_var: Optional[Variable] = None
         self.type = "optimizer"
 
@@ -143,12 +144,105 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        from .dygraph import base as dy
+
+        if dy.in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list)
         program = loss.block.program
         with program_guard(program, startup_program):
             params_grads = self.backward(loss, startup_program,
                                          parameter_list, no_grad_set)
             optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
+
+    # -- dygraph (eager) path --------------------------------------------
+    def _dygraph_minimize(self, loss, parameter_list=None):
+        """Apply the update rule eagerly on (param, param._grad) pairs
+        (reference dygraph minimize: optimizer ops run immediately on the
+        grad twins). Reuses the SAME registry update-rule lowerings as the
+        compiled path. Call loss.backward() first."""
+        import jax.numpy as jnp
+
+        from .core import registry
+        from .dygraph import base as dy
+        from .lowering import LowerCtx
+
+        if parameter_list is None:
+            raise ValueError(
+                "dygraph minimize needs parameter_list (e.g. "
+                "model.parameters()); the tape does not own the params")
+        if type(self)._eager_slots is Optimizer._eager_slots and \
+                self.type not in ("sgd",):
+            raise NotImplementedError(
+                f"{type(self).__name__} has no eager (dygraph) update path "
+                f"yet — supported: SGD, Momentum, Adam/AdamW/Lamb")
+        from .clip import _clip_attr
+
+        if _clip_attr.get("__global__") is not None:
+            raise NotImplementedError(
+                "set_gradient_clip is not applied in dygraph mode — clip "
+                "gradients manually before minimize")
+        params = [p for p in parameter_list if p._grad is not None]
+        if not params:
+            raise RuntimeError(
+                "no gradients found — call loss.backward() before minimize")
+        lr = self._current_lr()
+        ctx = LowerCtx()
+        updated = []
+        for p in params:
+            grad = self._eager_regularized_grad(p)
+            slots = self._eager_slots(p)
+            ins = {"Param": [p.value],
+                   "Grad": [grad],
+                   "LearningRate": [jnp.asarray([lr], p.value.dtype)]}
+            for k, v in slots.items():
+                ins[k] = [v]
+            outs = registry.get_op_def(self.type).lower(
+                ctx, ins, self._eager_attrs())
+            p.set_value(outs["ParamOut"][0])
+            self._eager_store(p, outs)
+            updated.append(p)
+        return updated, [(p, p._grad) for p in params]
+
+    def _eager_regularized_grad(self, p):
+        """L1/L2 weight decay folded into the grad, matching the static
+        append_regularization_ops semantics."""
+        import jax.numpy as jnp
+
+        from .regularizer import L1DecayRegularizer, L2DecayRegularizer
+
+        g = p._grad
+        reg = self.regularization
+        if reg is None:
+            return g
+        if isinstance(reg, L2DecayRegularizer):
+            return g + reg._coeff * p.value
+        if isinstance(reg, L1DecayRegularizer):
+            return g + reg._coeff * jnp.sign(p.value)
+        raise NotImplementedError(
+            f"dygraph regularization for {type(reg).__name__}")
+
+    def _current_lr(self) -> float:
+        lr = self._learning_rate
+        if isinstance(lr, Variable):
+            raise TypeError("dygraph mode needs a float learning rate")
+        return float(lr)
+
+    def _eager_state(self, p) -> dict:
+        # keyed per optimizer INSTANCE (like the static _accumulators):
+        # a fresh optimizer over the same params starts with fresh moments
+        st = self._eager_accumulators.setdefault(id(p), {})
+        return st
+
+    def _eager_slots(self, p) -> dict:
+        """Extra input slots (accumulators) for this rule; default none."""
+        return {}
+
+    def _eager_store(self, p, outs) -> None:
+        """Persist accumulator outputs after the update; default none."""
+
+    def _eager_attrs(self) -> dict:
+        return {}
 
 
 class SGDOptimizer(Optimizer):
@@ -186,6 +280,20 @@ class MomentumOptimizer(Optimizer):
                     "LearningRate": self._create_param_lr(param_and_grad)},
             outputs={"ParamOut": p, "VelocityOut": vel},
             attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+    def _eager_attrs(self):
+        return {"mu": self._momentum, "use_nesterov": self._use_nesterov}
+
+    def _eager_slots(self, p):
+        import jax.numpy as jnp
+
+        st = self._eager_state(p)
+        if "velocity" not in st:
+            st["velocity"] = jnp.zeros_like(p.value)
+        return {"Velocity": st["velocity"]}
+
+    def _eager_store(self, p, outs):
+        self._eager_state(p)["velocity"] = outs["VelocityOut"][0]
 
 
 class LarsMomentumOptimizer(Optimizer):
@@ -298,6 +406,28 @@ class AdamOptimizer(Optimizer):
     def _op_attrs(self):
         return {"beta1": self._beta1, "beta2": self._beta2,
                 "epsilon": self._epsilon}
+
+    def _eager_attrs(self):
+        return self._op_attrs()
+
+    def _eager_slots(self, p):
+        import jax.numpy as jnp
+
+        st = self._eager_state(p)
+        if "moment1" not in st:
+            st["moment1"] = jnp.zeros_like(p.value)
+            st["moment2"] = jnp.zeros_like(p.value)
+            st["beta1_pow"] = jnp.asarray([self._beta1], p.value.dtype)
+            st["beta2_pow"] = jnp.asarray([self._beta2], p.value.dtype)
+        return {"Moment1": st["moment1"], "Moment2": st["moment2"],
+                "Beta1Pow": st["beta1_pow"], "Beta2Pow": st["beta2_pow"]}
+
+    def _eager_store(self, p, outs):
+        st = self._eager_state(p)
+        st["moment1"] = outs["Moment1Out"][0]
+        st["moment2"] = outs["Moment2Out"][0]
+        st["beta1_pow"] = outs["Beta1PowOut"][0]
+        st["beta2_pow"] = outs["Beta2PowOut"][0]
 
 
 class AdamWOptimizer(AdamOptimizer):

@@ -1,0 +1,179 @@
+"""Dygraph (eager) mode — VERDICT r2 item 7 done-criterion: an MNIST MLP
+trains eagerly to the same losses as the static-graph path (reference
+imperative/tracer.h TraceOp + dygraph/layers.py Layer)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+
+
+class MLP(dygraph.Layer):
+    def __init__(self, hidden=32):
+        super().__init__("mlp")
+        self.fc1 = dygraph.FC(784, hidden, act="relu")
+        self.fc2 = dygraph.FC(hidden, 10)
+
+    def forward(self, x):
+        return self.fc2(self.fc1(x))
+
+
+def _static_reference(w1, b1, w2, b2, xb, yb, steps, lr):
+    """The same model/updates on the static path, params force-set."""
+    import paddle_tpu.unique_name as un
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[784], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, w1.shape[1], act="relu", name="s1")
+            logits = fluid.layers.fc(h, 10, name="s2")
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # overwrite the random init with the dygraph model's params
+        import jax.numpy as jnp
+
+        for name, arr in [("s1.w_0", w1), ("s1.b_0", b1),
+                          ("s2.w_0", w2), ("s2.b_0", b2)]:
+            assert scope.find_var(name) is not None, list(scope.vars)
+            scope.set_var(name, jnp.asarray(arr))
+        for _ in range(steps):
+            (lv,) = exe.run(main, feed={"x": xb, "y": yb},
+                            fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    return losses
+
+
+def test_dygraph_mnist_matches_static():
+    rng = np.random.RandomState(0)
+    xb = rng.randn(32, 784).astype(np.float32)
+    yb = rng.randint(0, 10, (32, 1)).astype(np.int64)
+    steps, lr = 6, 0.5
+
+    with dygraph.guard():
+        dygraph.seed_parameters(7)
+        model = MLP()
+        w1, b1 = model.fc1.weight.numpy(), model.fc1.bias.numpy()
+        w2, b2 = model.fc2.weight.numpy(), model.fc2.bias.numpy()
+        opt = fluid.optimizer.SGD(learning_rate=lr)
+        dy_losses = []
+        x = dygraph.to_variable(xb)
+        y = dygraph.to_variable(yb)
+        for _ in range(steps):
+            logits = model(x)
+            _, ce = dygraph.ops.softmax_with_cross_entropy(logits, y)
+            loss = dygraph.ops.mean(ce)
+            dy_losses.append(float(loss.numpy()))
+            loss.backward()
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+
+    st_losses = _static_reference(w1, b1, w2, b2, xb, yb, steps, lr)
+    np.testing.assert_allclose(dy_losses, st_losses, rtol=1e-4, atol=1e-6)
+    assert dy_losses[-1] < dy_losses[0]
+
+
+def test_dygraph_adam_trains():
+    rng = np.random.RandomState(1)
+    xb = rng.randn(16, 8).astype(np.float32)
+    w_true = rng.randn(8, 1).astype(np.float32)
+    yb = xb @ w_true
+    with dygraph.guard():
+        fc = dygraph.Linear(8, 1)
+        opt = fluid.optimizer.Adam(learning_rate=0.05)
+        first = last = None
+        for _ in range(40):
+            pred = fc(dygraph.to_variable(xb))
+            loss = dygraph.ops.mean(
+                dygraph.ops.square(pred - dygraph.to_variable(yb)))
+            loss.backward()
+            opt.minimize(loss, parameter_list=fc.parameters())
+            fc.clear_gradients()
+            last = float(loss.numpy())
+            first = first if first is not None else last
+    assert last < first * 0.05
+
+
+def test_dygraph_layers_forward():
+    """Conv2D/BatchNorm/Pool2D/LayerNorm/Embedding/Dropout eager shapes."""
+    rng = np.random.RandomState(2)
+    with dygraph.guard():
+        img = dygraph.to_variable(rng.randn(2, 3, 8, 8).astype(np.float32))
+        conv = dygraph.Conv2D(3, 6, 3, padding=1, act="relu")
+        bn = dygraph.BatchNorm(6)
+        pool = dygraph.Pool2D(2, "max", 2)
+        out = pool(bn(conv(img)))
+        assert out.shape == (2, 6, 4, 4)
+
+        ln = dygraph.LayerNorm(16)
+        z = ln(dygraph.to_variable(rng.randn(4, 16).astype(np.float32)))
+        assert z.shape == (4, 16)
+        np.testing.assert_allclose(z.numpy().mean(axis=-1), 0, atol=1e-5)
+
+        emb = dygraph.Embedding([50, 12])
+        e = emb(dygraph.to_variable(np.array([[1, 2], [3, 4]], np.int64)))
+        assert e.shape == (2, 2, 12)
+
+        drop = dygraph.Dropout(0.5)
+        drop.eval()
+        d = drop(z)
+        # reference downgrade_in_infer: inference output is x * (1 - p)
+        np.testing.assert_allclose(d.numpy(), z.numpy() * 0.5, rtol=1e-6)
+
+        # BatchNorm running stats moved after a train-mode forward
+        assert not np.allclose(bn._mean.numpy(), 0)
+
+
+def test_dygraph_python_control_flow():
+    """The dygraph point: data-dependent Python control flow just works."""
+    with dygraph.guard():
+        fc = dygraph.Linear(4, 4)
+        x = dygraph.to_variable(np.ones((2, 4), np.float32))
+        h = x
+        steps = 0
+        while float(dygraph.ops.mean(h).numpy()) < 5 and steps < 50:
+            h = dygraph.ops.relu(fc(h)) + 1.0
+            steps += 1
+        assert steps > 0
+        loss = dygraph.ops.mean(h)
+        loss.backward()
+        assert fc.weight.gradient() is not None
+
+
+def test_dygraph_state_dict_roundtrip(tmp_path):
+    with dygraph.guard():
+        model = MLP(hidden=16)
+        ref = model.fc1.weight.numpy().copy()
+        dygraph.save_dygraph(model.state_dict(), str(tmp_path / "mlp"))
+
+        model2 = MLP(hidden=16)
+        assert not np.allclose(model2.fc1.weight.numpy(), ref)
+        state, _ = dygraph.load_dygraph(str(tmp_path / "mlp"))
+        model2.set_dict(state)
+        np.testing.assert_array_equal(model2.fc1.weight.numpy(), ref)
+
+        with pytest.raises(ValueError, match="shape"):
+            bad = dict(state)
+            bad["fc1.weight"] = np.zeros((2, 2), np.float32)
+            model2.set_dict(bad)
+
+
+def test_dygraph_grad_accumulation_and_clear():
+    with dygraph.guard():
+        fc = dygraph.Linear(3, 1)
+        x = dygraph.to_variable(np.ones((2, 3), np.float32))
+        loss = dygraph.ops.mean(fc(x))
+        loss.backward()
+        g1 = fc.weight.gradient().copy()
+        loss2 = dygraph.ops.mean(fc(x))
+        loss2.backward()
+        np.testing.assert_allclose(fc.weight.gradient(), 2 * g1, rtol=1e-6)
+        fc.clear_gradients()
+        assert fc.weight.gradient() is None
