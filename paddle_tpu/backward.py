@@ -143,6 +143,18 @@ def _append_backward_core(block, targets: Sequence[Variable],
         if not any_out_grad:
             continue
 
+        # this op's grad consumes the cotangents of its outputs; clear them
+        # BEFORE registering in_grad contributions so an EARLIER producer of
+        # the same name (in-place update, e.g. a while writing its own
+        # input) doesn't re-sum them — the earlier producer's cotangent is
+        # exactly the in_grad contribution this op registers below
+        # (reference _addup_repetitive_outputs_ reaches the same effect by
+        # renaming repeated outputs)
+        for names in op.outputs.values():
+            for n in names:
+                if n != EMPTY_VAR_NAME and acc.contribs.get(n):
+                    acc.contribs[n] = []
+
         # which inputs get grads?
         in_grad: Dict[str, List[str]] = {}
         any_in_grad = False
@@ -174,6 +186,7 @@ def _append_backward_core(block, targets: Sequence[Variable],
             desc = _make_grad_op(op, out_grad, in_grad)
             block.append_op(desc["type"], inputs=desc["inputs"],
                             outputs=desc["outputs"], attrs=desc["attrs"])
+
     return acc
 
 

@@ -110,13 +110,15 @@ class VarBase:
                f"dtype={self.dtype})"
 
     # -- arithmetic (reference math_op_patch for VarBase) ----------------
-    def _binary(self, other, op):
+    def _binary(self, other, op, reverse=False):
         from . import ops
 
         if not isinstance(other, VarBase):
-            other = VarBase(jnp.asarray(other, self.dtype),
-                            stop_gradient=True)
-        return getattr(ops, op)(self, other)
+            # keep numpy/jnp promotion semantics (a float scalar promotes an
+            # int tensor; forcing self.dtype would truncate it)
+            other = VarBase(jnp.asarray(other), stop_gradient=True)
+        a, b = (other, self) if reverse else (self, other)
+        return getattr(ops, op)(a, b)
 
     def __add__(self, o):
         return self._binary(o, "elementwise_add")
@@ -126,6 +128,9 @@ class VarBase:
     def __sub__(self, o):
         return self._binary(o, "elementwise_sub")
 
+    def __rsub__(self, o):
+        return self._binary(o, "elementwise_sub", reverse=True)
+
     def __mul__(self, o):
         return self._binary(o, "elementwise_mul")
 
@@ -134,8 +139,14 @@ class VarBase:
     def __truediv__(self, o):
         return self._binary(o, "elementwise_div")
 
+    def __rtruediv__(self, o):
+        return self._binary(o, "elementwise_div", reverse=True)
+
     def __matmul__(self, o):
         return self._binary(o, "matmul")
+
+    def __rmatmul__(self, o):
+        return self._binary(o, "matmul", reverse=True)
 
     def __neg__(self):
         from . import ops
@@ -213,9 +224,10 @@ class Tape:
         return out_vbs
 
     # -- autograd (reference BasicEngine::Execute) -----------------------
-    def _replay(self, target_uid: int, leaf_uids: List[int]):
+    def _replay(self, target_uid: int, leaf_uids: List[int],
+                entries: Optional[List["_TapeEntry"]] = None):
         """Build the pure function leaf_values -> scalar(target)."""
-        entries = self.entries
+        entries = self.entries if entries is None else entries
         const = self.const_values
         base_key = self.base_key
 
@@ -243,12 +255,24 @@ class Tape:
             raise RuntimeError(
                 f"backward() target {loss.name} was not produced on this "
                 f"tape (created outside dygraph ops?)")
+        # backward slice: only entries reachable from the loss replay, and
+        # only leaves those entries read — unrelated parameters keep
+        # gradient()==None instead of silently receiving zeros (and AdamW
+        # weight decay never touches them)
+        needed = {loss.uid}
+        live_entries = []
+        for e in reversed(self.entries):
+            if any(u in needed for uids in e.outs.values() for u in uids):
+                live_entries.append(e)
+                needed.update(u for uids in e.ins.values()
+                              for u in uids if u is not None)
+        live_entries.reverse()
         leaf_uids = [u for u, vb in self.leaves.items()
-                     if not vb.stop_gradient
+                     if u in needed and not vb.stop_gradient
                      and jnp.issubdtype(vb.value.dtype, jnp.inexact)]
         if not leaf_uids:
             raise RuntimeError("backward(): no differentiable leaves found")
-        fn = self._replay(loss.uid, leaf_uids)
+        fn = self._replay(loss.uid, leaf_uids, live_entries)
         leaf_vals = [self.leaves[u].value for u in leaf_uids]
         grads = jax.grad(fn)(leaf_vals)
         for u, g in zip(leaf_uids, grads):

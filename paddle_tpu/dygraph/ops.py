@@ -69,9 +69,13 @@ _ALIASES = {"reshape": "reshape2", "transpose": "transpose2",
 
 def __getattr__(name: str):
     op_type = _ALIASES.get(name, name)
+    first_only = name in _ALIASES  # strip the versioned ops' dummy XShape
     if registry.has_op(op_type):
         def fn(*args, **attrs):
-            return _call_op(op_type, *args, **attrs)
+            r = _call_op(op_type, *args, **attrs)
+            if first_only and isinstance(r, tuple):
+                return r[0]
+            return r
 
         fn.__name__ = name
         fn.__qualname__ = f"dygraph.ops.{name}"

@@ -12,8 +12,9 @@ Executor and dynamic StepScopes; XLA needs structured control flow:
 * ``while``       -> ``lax.while_loop``. Loop state = the op's Out vars (all
                      parent-block vars the body writes). Tensor arrays in the
                      carry become fixed-capacity buffers (see TensorArrayVal).
-                     Non-differentiable (lax.while_loop has no reverse-mode);
-                     the training-side RNN story is ``recurrent``.
+                     DIFFERENTIABLE when constructed with max_len: the grad
+                     op replays the loop as a masked lax.scan under jax.vjp
+                     (reference WhileGradOp over saved StepScopes).
 * ``conditional_block`` -> ``lax.cond`` with a zero/passthrough else-branch.
 * ``recurrent``   -> ``lax.scan`` over the time axis: memories are the carry,
                      step inputs the xs, step outputs the stacked ys. Fully
@@ -188,39 +189,172 @@ def _as_pred(v):
     return jnp.asarray(v).reshape(()).astype(bool)
 
 
-def _while_lower(ctx, op, env):
-    program = ctx.program
-    sub = program.blocks[op.attrs["sub_block"]]
+def _while_carry(op, env, capacity):
     cond_name = op.inputs["Condition"][0]
     out_names = list(dict.fromkeys(op.outputs.get("Out", [])))
     carry_names = [cond_name] + [n for n in out_names if n != cond_name]
-    capacity = int(op.attrs.get("max_len") or _DEFAULT_CAPACITY)
-
     init = []
     for n in carry_names:
         v = env[n]
         if isinstance(v, TensorArrayVal):
             v = v.to_buffer(capacity)
         init.append(v)
+    return carry_names, init
 
-    def cond_fn(carry):
-        return _as_pred(carry[0])
 
+def _while_body(sub, carry_names, env, ctx, capacity):
     def body_fn(carry):
         benv = dict(env)  # outer reads close over (loop-invariant)
         benv.update(zip(carry_names, carry))
         lower_block(sub, benv, ctx)
         new = []
-        for n, old in zip(carry_names, carry):
+        for n in carry_names:
             v = benv[n]
             if isinstance(v, TensorArrayVal) and not v.buffered:
                 v = v.to_buffer(capacity)
             new.append(v)
         return tuple(new)
 
-    final = jax.lax.while_loop(cond_fn, body_fn, tuple(init))
+    return body_fn
+
+
+def _while_init_key(uid):
+    return f"__while_init_{uid}__"
+
+
+def _while_lower(ctx, op, env):
+    program = ctx.program
+    sub = program.blocks[op.attrs["sub_block"]]
+    max_len = int(op.attrs.get("max_len") or 0)
+    capacity = max_len or _DEFAULT_CAPACITY
+    carry_names, init = _while_carry(op, env, capacity)
+    body_fn = _while_body(sub, carry_names, env, ctx, capacity)
+
+    if max_len > 0:
+        # max_len BOUNDS the loop (a counter rides the carry), so the
+        # forward while_loop and the grad op's max_len-step masked scan
+        # see identical trip counts — otherwise a condition that outlives
+        # max_len would make the backward silently differentiate a shorter
+        # loop than the forward ran
+        def cond_fn(c):
+            return _as_pred(c[1][0]) & (c[0] < max_len)
+
+        def body(c):
+            return c[0] + 1, body_fn(c[1])
+
+        _, final = jax.lax.while_loop(cond_fn, body,
+                                      (jnp.asarray(0, jnp.int32),
+                                       tuple(init)))
+    else:
+        final = jax.lax.while_loop(lambda c: _as_pred(c[0]), body_fn,
+                                   tuple(init))
     for n, v in zip(carry_names, final):
         env[n] = v
+    # stash the pre-loop carry for the grad op (same trace): the while
+    # writes its outputs in place, so the inits are gone from env after this
+    env[_while_init_key(op.attrs.get("__uid__", 0))] = (carry_names, init)
+
+
+def _zero_ct(v):
+    """Cotangent of zeros matching a carry leaf (float0 for integer/bool
+    leaves, per jax.vjp's convention)."""
+    def leaf(a):
+        a = jnp.asarray(a)
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            return jnp.zeros_like(a)
+        return jnp.zeros(a.shape, jax.dtypes.float0)
+
+    return jax.tree.map(leaf, v)
+
+
+def _while_grad_lower(ctx, op, env):
+    """Differentiable bounded while (VERDICT r2 item 8; reference
+    while_op.cc WhileGradOp runs the body backward over saved StepScopes).
+
+    The loop is replayed as a lax.scan over max_len iterations with an
+    active-mask select — identical values to the forward while_loop, since
+    once the condition goes false the carry is frozen — and jax.vjp through
+    the scan yields grads for the carried inits and the loop-invariant
+    external reads."""
+    attrs = op.attrs
+    max_len = int(attrs.get("max_len") or 0)
+    if max_len <= 0:
+        raise ValueError(
+            "differentiating a While requires a static iteration bound: "
+            "construct it as layers.While(cond, max_len=N) (XLA reverse-mode"
+            " needs a fixed trip count to unroll the backward scan over)")
+    sub = ctx.program.blocks[attrs["sub_block"]]
+    fwd_uid = attrs.get("__fwd_uid__", 0)
+    stash = env.get(_while_init_key(fwd_uid))
+    if stash is None:
+        raise RuntimeError("while_grad lowered without its forward op in "
+                           "the same trace")
+    carry_names, init = stash
+    fwd_ctx = ctx.with_uid(fwd_uid)
+
+    # loop-invariant differentiable external reads (body closure)
+    body_reads = [n for n in op.inputs.get("X", [])
+                  if n not in carry_names and n in env
+                  and not isinstance(env[n], TensorArrayVal)
+                  and jnp.issubdtype(jnp.result_type(env[n]), jnp.inexact)]
+    # differentiable carry positions (plain float arrays)
+    diff_pos = [i for i, v in enumerate(init)
+                if not isinstance(v, TensorArrayVal)
+                and jnp.issubdtype(jnp.result_type(v), jnp.inexact)]
+
+    def fn(diff_init, read_vals):
+        base_env = dict(env)
+        base_env.update(zip(body_reads, read_vals))
+        cur = list(init)
+        for i, v in zip(diff_pos, diff_init):
+            cur[i] = v
+        body_fn = _while_body(sub, carry_names, base_env, fwd_ctx,
+                              max_len)
+
+        def step(carry, _):
+            cond = _as_pred(carry[0])
+            new = body_fn(carry)
+            sel = tuple(
+                jax.tree.map(lambda a, b: jnp.where(cond, a, b), n_, o_)
+                for n_, o_ in zip(new, carry))
+            return sel, None
+
+        final, _ = jax.lax.scan(step, tuple(cur), None, length=max_len)
+        return tuple(final[i] for i in diff_pos)
+
+    primal_init = [init[i] for i in diff_pos]
+    read_vals = [env[n] for n in body_reads]
+    outs, vjp_fn = jax.vjp(fn, primal_init, read_vals)
+
+    # cotangents: Out@GRAD entries aligned with the forward Out list
+    grad_of = {}
+    for n, g in zip(op.inputs.get("__out__Out", []),
+                    op.inputs.get("Out@GRAD", [])):
+        if g != EMPTY and n not in grad_of:
+            grad_of[n] = g
+    cts = []
+    for k, i in enumerate(diff_pos):
+        n = carry_names[i]
+        g = env.get(grad_of.get(n, ""), None)
+        if g is None:
+            cts.append(_zero_ct(outs[k]))
+        else:
+            cts.append(jnp.asarray(g).astype(outs[k].dtype)
+                       .reshape(outs[k].shape))
+    g_init, g_reads = vjp_fn(tuple(cts))
+
+    x_names = op.inputs.get("X", [])
+    g_names = op.outputs.get("X@GRAD", [])
+    carry_grad = {carry_names[i]: g for i, g in zip(diff_pos, g_init)}
+    read_grad = dict(zip(body_reads, g_reads))
+    for n, gname in zip(x_names, g_names):
+        if gname == EMPTY:
+            continue
+        g = carry_grad.get(n)
+        if g is None:
+            g = read_grad.get(n)
+        if g is not None:
+            env[gname] = g
 
 
 register_op("while",
@@ -228,7 +362,7 @@ register_op("while",
             outputs=[IOSpec("Out", duplicable=True),
                      IOSpec("StepScopes", optional=True)],
             attrs={"sub_block": None, "max_len": 0, "is_test": False},
-            grad=None, raw=True,
+            grad="auto", grad_lower=_while_grad_lower, raw=True,
             infer_shape=lambda op, block: None)(_while_lower)
 
 

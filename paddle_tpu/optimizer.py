@@ -229,9 +229,10 @@ class Optimizer:
         return float(lr)
 
     def _eager_state(self, p) -> dict:
-        # keyed per optimizer INSTANCE (like the static _accumulators):
-        # a fresh optimizer over the same params starts with fresh moments
-        st = self._eager_accumulators.setdefault(id(p), {})
+        # keyed per optimizer INSTANCE (like the static _accumulators) and
+        # by the VarBase's stable uid — id(p) could be recycled after GC
+        # and hand a new parameter a dead one's moments
+        st = self._eager_accumulators.setdefault(p.uid, {})
         return st
 
     def _eager_slots(self, p) -> dict:
