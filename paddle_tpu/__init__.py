@@ -32,6 +32,7 @@ from . import optimizer
 from . import profiler
 from . import regularizer
 from .core import registry as op_registry
+from .flags import get_flags, set_flags
 from .layers import learning_rate_scheduler  # registers fluid.layers.* decays
 
 __version__ = "0.1.0"

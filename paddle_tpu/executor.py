@@ -179,19 +179,34 @@ def analyze_block_io(block, feed_names: set, fetch_names) -> dict:
             "state_out": state_out, "donated": donated, "ro": ro}
 
 
-def make_step_fn(block, io: dict, fetch_names, mesh=None):
-    """The traced step body shared by all execution paths."""
+def make_step_fn(block, io: dict, fetch_names, mesh=None,
+                 nan_check_meta=None):
+    """The traced step body shared by all execution paths.
+
+    ``nan_check_meta``: pass a list to enable FLAGS_check_nan_inf — at trace
+    time it fills with one label per float op output and the step returns an
+    extra bool vector (aligned with the labels) that the executor inspects
+    host-side (reference operator.cc fast_check_nan_inf, but one fused
+    check vector per step instead of a sync per op)."""
 
     def step_fn(feed_vals, donated_vals, ro_vals, rng_key):
         env: Dict[str, Any] = {}
         env.update(zip(io["feed_order"], feed_vals))
         env.update(zip(io["donated"], donated_vals))
         env.update(zip(io["ro"], ro_vals))
+        checks = None if nan_check_meta is None else []
         ctx = LowerCtx(base_key=rng_key, mesh=mesh,
-                       program=getattr(block, "program", None))
+                       program=getattr(block, "program", None),
+                       nan_checks=checks)
         lower_block(block, env, ctx)
         fetches = [env[n] for n in fetch_names]
         new_state = [env[n] for n in io["state_out"]]
+        if checks is not None:
+            nan_check_meta.clear()
+            nan_check_meta.extend(label for label, _ in checks)
+            flags_vec = (jnp.stack([ok for _, ok in checks])
+                         if checks else jnp.ones((0,), bool))
+            return fetches, new_state, flags_vec
         return fetches, new_state
 
     return step_fn
@@ -252,7 +267,23 @@ class Executor:
         ro_vals = read_state(step.ro_names)
         key = jax.random.key(self._next_seed(program))
         with jax.default_device(self.place.jax_device()):
-            fetches, new_state = step.fn(feed_vals, donated_vals, ro_vals, key)
+            result = step.fn(feed_vals, donated_vals, ro_vals, key)
+        if len(result) == 3:  # FLAGS_check_nan_inf run
+            fetches, new_state, ok_vec = result
+            ok = np.asarray(ok_vec)
+            if not ok.all():
+                # the inputs were donated: write the step's outputs back
+                # FIRST or the scope would point at deleted buffers and the
+                # session would be unusable after catching the error
+                for n, v in zip(step.state_out_names, new_state):
+                    scope.set_var(n, v)
+                bad = int(np.argmin(ok))
+                label = step.nan_check_meta[bad] if \
+                    bad < len(step.nan_check_meta) else f"check #{bad}"
+                raise FloatingPointError(
+                    f"FLAGS_check_nan_inf: non-finite value in {label}")
+        else:
+            fetches, new_state = result
         for n, v in zip(step.state_out_names, new_state):
             scope.set_var(n, v)
         if return_numpy:
@@ -293,8 +324,10 @@ class Executor:
             (n, tuple(np.asarray(v).shape), str(np.asarray(v).dtype))
             for n, v in feed.items()
         ))
+        from .flags import flag
+
         key = (self._program_fingerprint(program), feed_sig,
-               tuple(fetch_names), id(scope))
+               tuple(fetch_names), id(scope), flag("check_nan_inf"))
         if use_cache and key in self._cache:
             return self._cache[key]
         step = self._compile(program, set(feed.keys()), fetch_names, scope)
@@ -303,9 +336,14 @@ class Executor:
         return step
 
     def _compile(self, program: Program, feed_names: set, fetch_names, scope):
+        from .flags import flag
+
         block = program.global_block
         io = analyze_block_io(block, feed_names, fetch_names)
-        step_fn = make_step_fn(block, io, fetch_names)
+        meta = [] if flag("check_nan_inf") else None
+        step_fn = make_step_fn(block, io, fetch_names, nan_check_meta=meta)
         jitted = jax.jit(step_fn, donate_argnums=(1,))
-        return _CompiledStep(jitted, io["feed_order"], io["donated"], io["ro"],
-                             io["state_out"], tuple(fetch_names))
+        step = _CompiledStep(jitted, io["feed_order"], io["donated"],
+                             io["ro"], io["state_out"], tuple(fetch_names))
+        step.nan_check_meta = meta  # filled lazily at first trace
+        return step

@@ -1,0 +1,72 @@
+"""FLAGS_* config shim (reference paddle/fluid/platform/flags.cc + the
+``FLAGS_*`` env contract surfaced through core.init_gflags).
+
+Flags resolve, in order: explicit ``set_flags`` > ``FLAGS_<name>`` env var >
+default. Memory/allocator knobs from the reference are accepted for script
+compatibility but inert — XLA owns device memory (documented per flag).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+__all__ = ["get_flags", "set_flags", "flag"]
+
+# name -> (type, default, meaning)
+_DEFS: Dict[str, tuple] = {
+    # live flags
+    "check_nan_inf": (bool, False,
+                      "per-op finite checks with op provenance on failure "
+                      "(reference flags.cc:44; operator.cc fast_check_nan_inf)"),
+    "paddle_num_threads": (int, 1, "host threads hint (XLA owns scheduling)"),
+    "seq_bucket_sizes": (str, "", "override DataFeeder varlen buckets, csv"),
+    # accepted-for-compat, inert on TPU (XLA/PJRT owns memory)
+    "fraction_of_gpu_memory_to_use": (float, 0.92, "inert: XLA preallocates"),
+    "allocator_strategy": (str, "auto_growth", "inert: XLA buffer assignment"),
+    "eager_delete_tensor_gb": (float, 0.0, "inert: no GC, donation instead"),
+    "memory_fraction_of_eager_deletion": (float, 1.0, "inert"),
+    "init_allocated_mem": (bool, False, "inert"),
+    "selected_gpus": (str, "", "inert: device choice is Place/mesh-driven"),
+    "selected_tpus": (str, "", "device index hint for TPUPlace"),
+    "cudnn_deterministic": (bool, False, "inert: XLA is deterministic"),
+}
+
+_overrides: Dict[str, Any] = {}
+
+
+def _coerce(typ, raw):
+    if typ is bool:
+        return str(raw).lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+def flag(name: str):
+    """Current value of one flag."""
+    if name not in _DEFS:
+        raise KeyError(f"unknown flag '{name}' — known: {sorted(_DEFS)}")
+    if name in _overrides:
+        return _overrides[name]
+    typ, default, _ = _DEFS[name]
+    raw = os.environ.get(f"FLAGS_{name}")
+    return default if raw is None else _coerce(typ, raw)
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    """reference fluid.get_flags."""
+    if names is None:
+        names = list(_DEFS)
+    if isinstance(names, str):
+        names = [names]
+    return {f"FLAGS_{n}": flag(n) for n in (x.replace("FLAGS_", "")
+                                            for x in names)}
+
+
+def set_flags(flags_dict: Dict[str, Any]) -> None:
+    """reference fluid.set_flags({'FLAGS_check_nan_inf': 1})."""
+    for k, v in flags_dict.items():
+        name = k.replace("FLAGS_", "")
+        if name not in _DEFS:
+            raise KeyError(f"unknown flag '{k}' — known: "
+                           f"{sorted('FLAGS_' + n for n in _DEFS)}")
+        typ = _DEFS[name][0]
+        _overrides[name] = _coerce(typ, v)

@@ -18,6 +18,8 @@ from __future__ import annotations
 import contextlib
 import copy
 import json
+import os
+import sys
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -391,6 +393,12 @@ class Block:
     def _stamp(self, op: Operator) -> None:
         op.attrs.setdefault("__uid__", self.program._next_uid())
         op.attrs.setdefault("__op_role__", self.program._op_role)
+        if "op_callstack" not in op.attrs:
+            site = _user_call_site()
+            if site:
+                # reference framework/op_call_stack.h: the op remembers the
+                # user line that created it; lowering errors point here
+                op.attrs["op_callstack"] = site
 
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
         op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
@@ -650,6 +658,21 @@ def _current_tracer():
     from .dygraph import base as _dy
 
     return _dy._tape
+
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _user_call_site() -> str:
+    """First stack frame outside paddle_tpu — the user line that created the
+    op (reference op_call_stack.cc InsertCallStackInfo)."""
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_DIR):
+            return f"{fn}:{f.f_lineno} in {f.f_code.co_name}"
+        f = f.f_back
+    return ""
 
 
 def _as_list(x) -> list:

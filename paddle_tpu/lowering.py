@@ -73,12 +73,16 @@ class LowerCtx:
     """Context passed to every op lowering rule."""
 
     def __init__(self, base_key=None, uid: int = 0, mesh=None, axis_env=None,
-                 program=None):
+                 program=None, nan_checks=None):
         self.base_key = base_key
         self.uid = uid
         self.mesh = mesh          # jax.sharding.Mesh when lowering under shard_map
         self.axis_env = axis_env  # dict of mesh axis names usable in collectives
         self.program = program    # owning Program: sub-block lookup for while/cond
+        # FLAGS_check_nan_inf: list collecting (label, finite-bool-scalar)
+        # per float op output during the trace; the executor fetches the
+        # bools and raises with the label on the first non-finite one
+        self.nan_checks = nan_checks
 
     def rng(self):
         """PRNG key unique to this op instance; grad ops fold in the forward
@@ -90,7 +94,7 @@ class LowerCtx:
 
     def with_uid(self, uid: int) -> "LowerCtx":
         return LowerCtx(self.base_key, uid, self.mesh, self.axis_env,
-                        self.program)
+                        self.program, self.nan_checks)
 
 
 def _gather_inputs(op, env: Dict[str, Any]) -> Dict[str, List[Any]]:
@@ -112,19 +116,53 @@ def _gather_inputs(op, env: Dict[str, Any]) -> Dict[str, List[Any]]:
     return ins
 
 
+def _op_site(op) -> str:
+    site = op.attrs.get("op_callstack", "")
+    return f" (created at {site})" if site else ""
+
+
 def lower_op(op, env: Dict[str, Any], ctx: LowerCtx) -> None:
     """Execute one op's lowering rule against the environment, in place."""
     if op.type in ("feed", "fetch"):  # spliced by the executor, never lowered
         return
+    try:
+        _lower_op_inner(op, env, ctx)
+    except _OpLoweringError:
+        raise
+    except Exception as e:
+        # reference op_call_stack.cc: errors carry the op type and the user
+        # line that appended the op
+        raise _OpLoweringError(
+            f"while lowering op '{op.type}'{_op_site(op)}: "
+            f"{type(e).__name__}: {e}") from e
+    if ctx.nan_checks is not None:
+        for name in op.output_arg_names:
+            v = env.get(name)
+            if v is not None and hasattr(v, "dtype") and \
+                    jnp.issubdtype(jnp.result_type(v), jnp.inexact):
+                ctx.nan_checks.append(
+                    (f"op '{op.type}' output '{name}'{_op_site(op)}",
+                     jnp.isfinite(v).all()))
+
+
+class _OpLoweringError(RuntimeError):
+    pass
+
+
+def _lower_op_inner(op, env: Dict[str, Any], ctx: LowerCtx) -> None:
     if op.type.endswith("_grad") and not registry.has_op(op.type):
         _lower_generic_grad(op, env, ctx)
         return
     opdef = registry.get_op_def(op.type)
     op_ctx = ctx.with_uid(op.attrs.get("__uid__", 0))
     if opdef.raw:
-        # control-flow ops interpret their sub-block themselves
+        # control-flow ops interpret their sub-block themselves. Their
+        # sub-block ops must NOT append nan checks: tracers created inside
+        # a lax.while/cond body cannot escape to the top-level check list —
+        # the control-flow op's own outputs are checked at this level.
         if op_ctx.program is None:
             op_ctx.program = op.block.program
+        op_ctx.nan_checks = None
         opdef.lower(op_ctx, op, env)
         return
     ins = _gather_inputs(op, env)
@@ -180,6 +218,10 @@ def _lower_generic_grad(op, env: Dict[str, Any], ctx: LowerCtx) -> None:
         if fwd_def.raw:
             if op_ctx.program is None:
                 op_ctx.program = op.block.program
+            # sub-block replays (while_grad/recurrent_grad/recompute) run
+            # inside scan/while bodies — their inner ops must not append to
+            # the top-level nan-check list (tracer escape)
+            op_ctx.nan_checks = None
             fwd_def.grad_lower(op_ctx, op, env)
             return
         # NOTE: no AMP cast here — a custom grad rule owns its precision.

@@ -151,8 +151,21 @@ class CompiledProgram:
             return vals
 
         key = jax.random.key(exe._next_seed(program))
-        fetches, new_state = step.fn(feed_vals, read(step.donated_names),
-                                     read(step.ro_names), key)
+        result = step.fn(feed_vals, read(step.donated_names),
+                         read(step.ro_names), key)
+        if len(result) == 3:  # FLAGS_check_nan_inf run
+            fetches, new_state, ok_vec = result
+            ok = np.asarray(_fetch_numpy(ok_vec))
+            if not ok.all():
+                for n, v in zip(step.state_out_names, new_state):
+                    scope.set_var(n, v)  # donated inputs are gone; see exe
+                bad = int(np.argmin(ok))
+                meta = getattr(step, "nan_check_meta", [])
+                label = meta[bad] if bad < len(meta) else f"check #{bad}"
+                raise FloatingPointError(
+                    f"FLAGS_check_nan_inf: non-finite value in {label}")
+        else:
+            fetches, new_state = result
         for n, v in zip(step.state_out_names, new_state):
             scope.set_var(n, v)
         if return_numpy:
@@ -164,7 +177,10 @@ class CompiledProgram:
             (n, tuple(np.asarray(v).shape), str(np.asarray(v).dtype))
             for n, v in feed.items()
         ))
-        key = (exe._program_fingerprint(program), feed_sig, tuple(fetch_names))
+        from ..flags import flag
+
+        key = (exe._program_fingerprint(program), feed_sig,
+               tuple(fetch_names), flag("check_nan_inf"))
         if key in self._cache:
             return self._cache[key]
         step = self._compile(program, set(feed.keys()), fetch_names, scope)
@@ -177,10 +193,14 @@ class CompiledProgram:
         over the mesh: feeds split on 'dp', state replicated."""
         from ..executor import _CompiledStep, analyze_block_io, make_step_fn
 
+        from ..flags import flag
+
         block = program.global_block
         io = analyze_block_io(block, feed_names, fetch_names)
         mesh = self._mesh
-        step_fn = make_step_fn(block, io, fetch_names, mesh=mesh)
+        nan_meta = [] if flag("check_nan_inf") else None
+        step_fn = make_step_fn(block, io, fetch_names, mesh=mesh,
+                               nan_check_meta=nan_meta)
 
         batch_spec = NamedSharding(mesh, P("dp"))
         repl_spec = NamedSharding(mesh, P())
@@ -228,10 +248,13 @@ class CompiledProgram:
             [repl_spec] * len(fetch_names),
             [state_shardings[n] for n in io["state_out"]],
         )
+        if nan_meta is not None:
+            out_shardings = out_shardings + (repl_spec,)
         jitted = jax.jit(step_fn, donate_argnums=(1,),
                          in_shardings=in_shardings,
                          out_shardings=out_shardings)
         step = _CompiledStep(jitted, io["feed_order"], io["donated"],
                              io["ro"], io["state_out"], tuple(fetch_names))
         step.state_shardings = state_shardings
+        step.nan_check_meta = nan_meta
         return step

@@ -1,0 +1,159 @@
+"""Error provenance, FLAGS shim, check_nan_inf (VERDICT r2 item 9;
+reference framework/op_call_stack.h, platform/flags.cc:44)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_op_callstack_recorded_and_in_lowering_errors():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, 8)  # THE user line
+    ops = main.global_block.ops
+    assert any("test_observability.py" in op.attrs.get("op_callstack", "")
+               for op in ops)
+
+    # a shape error at run time must name the op and the creation site
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(RuntimeError) as ei:
+            exe.run(main, feed={"x": np.ones((2, 7), np.float32)},  # 7 != 4
+                    fetch_list=[h.name])
+    msg = str(ei.value)
+    assert "mul" in msg and "test_observability.py" in msg, msg
+
+
+def test_flags_shim():
+    assert fluid.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is False
+    fluid.set_flags({"FLAGS_check_nan_inf": 1})
+    try:
+        assert fluid.get_flags(["check_nan_inf"])["FLAGS_check_nan_inf"] is True
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": 0})
+    with pytest.raises(KeyError, match="unknown flag"):
+        fluid.set_flags({"FLAGS_no_such_flag": 1})
+    # inert compat flags are accepted
+    fluid.set_flags({"FLAGS_fraction_of_gpu_memory_to_use": 0.5})
+
+
+def test_check_nan_inf_names_the_op():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.log(x)   # log of a negative -> nan
+        out = fluid.layers.mean(h)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    fluid.set_flags({"FLAGS_check_nan_inf": 1})
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            # finite input: passes
+            (v,) = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                           fetch_list=[out.name])
+            assert np.isfinite(v).all()
+            with pytest.raises(FloatingPointError) as ei:
+                exe.run(main,
+                        feed={"x": -np.ones((2, 4), np.float32)},
+                        fetch_list=[out.name])
+        assert "log" in str(ei.value), str(ei.value)
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": 0})
+
+
+def test_check_nan_inf_off_does_not_raise():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        out = fluid.layers.mean(fluid.layers.log(x))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (v,) = exe.run(main, feed={"x": -np.ones((2, 4), np.float32)},
+                       fetch_list=[out.name])
+    assert np.isnan(v).all()
+
+
+def test_check_nan_inf_keeps_scope_usable_after_error():
+    """Review regression: inputs are donated — after a sanitizer error the
+    scope must hold the step's outputs, not deleted buffers."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, 4, name="f")
+        out = fluid.layers.mean(fluid.layers.log(h))
+        fluid.optimizer.SGD(0.1).minimize(out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    fluid.set_flags({"FLAGS_check_nan_inf": 1})
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            with pytest.raises(FloatingPointError):
+                exe.run(main, feed={"x": -np.ones((2, 4), np.float32)},
+                        fetch_list=[out.name])
+            # the session must still run with clean input
+            (v,) = exe.run(main, feed={"x": np.ones((2, 4), np.float32) * 9},
+                           fetch_list=[out.name])
+        assert np.isfinite(v).all()
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": 0})
+
+
+def test_check_nan_inf_with_while_grad():
+    """Review regression: sub-block replays (while_grad) must not leak
+    tracers into the top-level check list."""
+    import paddle_tpu.unique_name as un
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            i = fluid.layers.fill_constant([1], "int64", 0)
+            n = fluid.layers.fill_constant([1], "int64", 3)
+            h = fluid.layers.fc(x, 4, name="g")
+            cond = fluid.layers.less_than(i, n)
+            w = fluid.layers.While(cond, max_len=3)
+            with w.block():
+                fluid.layers.assign(fluid.layers.scale(h, scale=0.5), h)
+                fluid.layers.increment(i, value=1)
+                fluid.layers.assign(fluid.layers.less_than(i, n), cond)
+            loss = fluid.layers.mean(h)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    fluid.set_flags({"FLAGS_check_nan_inf": 1})
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            (v,) = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                           fetch_list=[loss.name])
+        assert np.isfinite(v).all()
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": 0})
+
+
+def test_check_nan_inf_compiled_program():
+    """The flag works on the data-parallel path too."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        out = fluid.layers.mean(fluid.layers.log(x))
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=out.name)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    fluid.set_flags({"FLAGS_check_nan_inf": 1})
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            with pytest.raises(FloatingPointError, match="log"):
+                exe.run(compiled, feed={"x": -np.ones((8, 4), np.float32)},
+                        fetch_list=[out.name])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": 0})
