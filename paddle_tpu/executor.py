@@ -212,6 +212,27 @@ def make_step_fn(block, io: dict, fetch_names, mesh=None,
     return step_fn
 
 
+def unpack_step_result(step, result, scope, to_host=np.asarray):
+    """Shared FLAGS_check_nan_inf protocol for every execution path: a
+    3-tuple result carries the per-op finite flags. On failure the step's
+    outputs are written back FIRST (inputs were donated — without this the
+    scope would reference deleted buffers and the session would be unusable
+    after catching the error), then FloatingPointError names the op."""
+    if len(result) != 3:
+        return result
+    fetches, new_state, ok_vec = result
+    ok = np.asarray(to_host(ok_vec))
+    if not ok.all():
+        for n, v in zip(step.state_out_names, new_state):
+            scope.set_var(n, v)
+        bad = int(np.argmin(ok))
+        meta = getattr(step, "nan_check_meta", None) or []
+        label = meta[bad] if bad < len(meta) else f"check #{bad}"
+        raise FloatingPointError(
+            f"FLAGS_check_nan_inf: non-finite value in {label}")
+    return fetches, new_state
+
+
 class Executor:
     """Reference API (executor.py:380): run / close; plus train loop helpers."""
 
@@ -268,22 +289,7 @@ class Executor:
         key = jax.random.key(self._next_seed(program))
         with jax.default_device(self.place.jax_device()):
             result = step.fn(feed_vals, donated_vals, ro_vals, key)
-        if len(result) == 3:  # FLAGS_check_nan_inf run
-            fetches, new_state, ok_vec = result
-            ok = np.asarray(ok_vec)
-            if not ok.all():
-                # the inputs were donated: write the step's outputs back
-                # FIRST or the scope would point at deleted buffers and the
-                # session would be unusable after catching the error
-                for n, v in zip(step.state_out_names, new_state):
-                    scope.set_var(n, v)
-                bad = int(np.argmin(ok))
-                label = step.nan_check_meta[bad] if \
-                    bad < len(step.nan_check_meta) else f"check #{bad}"
-                raise FloatingPointError(
-                    f"FLAGS_check_nan_inf: non-finite value in {label}")
-        else:
-            fetches, new_state = result
+        fetches, new_state = unpack_step_result(step, result, scope)
         for n, v in zip(step.state_out_names, new_state):
             scope.set_var(n, v)
         if return_numpy:

@@ -36,7 +36,14 @@ _overrides: Dict[str, Any] = {}
 
 def _coerce(typ, raw):
     if typ is bool:
-        return str(raw).lower() in ("1", "true", "yes", "on")
+        if isinstance(raw, (int, float, bool)):
+            return bool(raw)  # gflags semantics: nonzero is true
+        s = str(raw).strip().lower()
+        if s in ("1", "true", "yes", "on"):
+            return True
+        if s in ("0", "false", "no", "off", ""):
+            return False
+        raise ValueError(f"not a boolean flag value: {raw!r}")
     return typ(raw)
 
 

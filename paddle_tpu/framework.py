@@ -660,7 +660,7 @@ def _current_tracer():
     return _dy._tape
 
 
-_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__)) + os.sep
 
 
 def _user_call_site() -> str:

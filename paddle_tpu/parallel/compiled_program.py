@@ -153,19 +153,10 @@ class CompiledProgram:
         key = jax.random.key(exe._next_seed(program))
         result = step.fn(feed_vals, read(step.donated_names),
                          read(step.ro_names), key)
-        if len(result) == 3:  # FLAGS_check_nan_inf run
-            fetches, new_state, ok_vec = result
-            ok = np.asarray(_fetch_numpy(ok_vec))
-            if not ok.all():
-                for n, v in zip(step.state_out_names, new_state):
-                    scope.set_var(n, v)  # donated inputs are gone; see exe
-                bad = int(np.argmin(ok))
-                meta = getattr(step, "nan_check_meta", [])
-                label = meta[bad] if bad < len(meta) else f"check #{bad}"
-                raise FloatingPointError(
-                    f"FLAGS_check_nan_inf: non-finite value in {label}")
-        else:
-            fetches, new_state = result
+        from ..executor import unpack_step_result
+
+        fetches, new_state = unpack_step_result(step, result, scope,
+                                                to_host=_fetch_numpy)
         for n, v in zip(step.state_out_names, new_state):
             scope.set_var(n, v)
         if return_numpy:

@@ -74,11 +74,14 @@ def compile_sharded_step(program, mesh: Mesh, feed_names: Sequence[str],
     in/out shardings. Returns (jitted_fn, io) where io describes arg order
     (see executor.analyze_block_io)."""
     from ..executor import analyze_block_io, make_step_fn
+    from ..flags import flag
 
     rules = rules or ShardingRules()
     block = program.global_block
     io = analyze_block_io(block, set(feed_names), fetch_names)
-    step_fn = make_step_fn(block, io, fetch_names, mesh=mesh)
+    nan_meta = [] if flag("check_nan_inf") else None
+    step_fn = make_step_fn(block, io, fetch_names, mesh=mesh,
+                           nan_check_meta=nan_meta)
 
     def state_shard(name):
         return rules.sharding_for_param(mesh, name)
@@ -95,9 +98,12 @@ def compile_sharded_step(program, mesh: Mesh, feed_names: Sequence[str],
         [NamedSharding(mesh, P())] * len(fetch_names),
         [state_shard(n) for n in io["state_out"]],
     )
+    if nan_meta is not None:
+        out_shardings = out_shardings + (NamedSharding(mesh, P()),)
     jitted = jax.jit(step_fn, in_shardings=in_shardings,
                      out_shardings=out_shardings,
                      donate_argnums=(1,) if donate else ())
+    io["nan_check_meta"] = nan_meta
     return jitted, io
 
 
