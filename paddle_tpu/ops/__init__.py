@@ -8,5 +8,7 @@ from . import control_flow  # noqa: F401
 from . import amp_ops  # noqa: F401
 from . import recompute  # noqa: F401
 from . import sequence_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
+from . import detection  # noqa: F401
 
 from ..core.registry import all_ops, get_op_def, has_op, register_op  # noqa: F401

@@ -195,3 +195,150 @@ def _auc(ctx, ins, attrs):
                     if auc.dtype == jnp.float64 else auc.reshape((1,))],
             "StatPosOut": [pos.reshape(pos_stat.shape)],
             "StatNegOut": [neg.reshape(neg_stat.shape)]}
+
+
+@register_op("kldiv_loss", inputs=[IOSpec("X"), IOSpec("Target", no_grad=True)],
+             outputs=["Loss"], attrs={"reduction": "mean"})
+def _kldiv_loss(ctx, ins, attrs):
+    """reference kldiv_loss_op.h: x is log-prob, target is prob."""
+    xv, t = x(ins, "X"), x(ins, "Target")
+    loss = t * (jnp.where(t > 0, jnp.log(jnp.where(t > 0, t, 1.0)), 0.0) - xv)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        return {"Loss": [jnp.mean(loss)]}
+    if red == "sum":
+        return {"Loss": [jnp.sum(loss)]}
+    if red == "batchmean":
+        return {"Loss": [jnp.sum(loss) / xv.shape[0]]}
+    return {"Loss": [loss]}
+
+
+@register_op("hinge_loss", inputs=[IOSpec("Logits"),
+                                   IOSpec("Labels", no_grad=True)],
+             outputs=["Loss"])
+def _hinge_loss(ctx, ins, attrs):
+    """reference hinge_loss_op.h: labels in {0,1}."""
+    logits, labels = x(ins, "Logits"), x(ins, "Labels")
+    signs = 2.0 * labels.astype(logits.dtype) - 1.0
+    return {"Loss": [jnp.maximum(0.0, 1.0 - signs * logits)]}
+
+
+@register_op("margin_rank_loss",
+             inputs=[IOSpec("Label", no_grad=True), IOSpec("X1"),
+                     IOSpec("X2")],
+             outputs=["Out", IOSpec("Activated", no_grad=True)],
+             attrs={"margin": 0.0})
+def _margin_rank_loss(ctx, ins, attrs):
+    lbl, x1, x2 = x(ins, "Label"), x(ins, "X1"), x(ins, "X2")
+    raw = -lbl * (x1 - x2) + attrs["margin"]
+    return {"Out": [jnp.maximum(0.0, raw)],
+            "Activated": [(raw > 0).astype(x1.dtype)]}
+
+
+@register_op("rank_loss", inputs=[IOSpec("Label", no_grad=True),
+                                  IOSpec("Left"), IOSpec("Right")],
+             outputs=["Out"])
+def _rank_loss(ctx, ins, attrs):
+    """reference rank_loss_op.h: RankNet pairwise loss."""
+    lbl, l, r = x(ins, "Label"), x(ins, "Left"), x(ins, "Right")
+    d = l - r
+    return out(jnp.logaddexp(0.0, d) - lbl * d)
+
+
+@register_op("bpr_loss", inputs=[IOSpec("X"), IOSpec("Label", no_grad=True)],
+             outputs=["Y"])
+def _bpr_loss(ctx, ins, attrs):
+    """reference bpr_loss_op.h: Bayesian Personalized Ranking over logits
+    [N, C] with positive-item label [N, 1]."""
+    xv, lbl = x(ins, "X"), x(ins, "Label")
+    pos = jnp.take_along_axis(xv, lbl.reshape(-1, 1).astype(jnp.int32), 1)
+    diff = pos - xv  # [N, C]
+    n, c = xv.shape
+    loss = -jnp.log(jax.nn.sigmoid(diff) + 1e-8)
+    mask = jnp.ones((n, c), xv.dtype).at[
+        jnp.arange(n), lbl.reshape(-1).astype(jnp.int32)].set(0.0)
+    return {"Y": [(loss * mask).sum(1, keepdims=True) / (c - 1)]}
+
+
+@register_op("cos_sim", inputs=[IOSpec("X"), IOSpec("Y")],
+             outputs=["Out", IOSpec("XNorm", no_grad=True),
+                      IOSpec("YNorm", no_grad=True)])
+def _cos_sim(ctx, ins, attrs):
+    xv, yv = x(ins, "X"), x(ins, "Y")
+    xn = jnp.sqrt((xv * xv).sum(-1, keepdims=True))
+    yn = jnp.sqrt((yv * yv).sum(-1, keepdims=True))
+    return {"Out": [(xv * yv).sum(-1, keepdims=True) / (xn * yn + 1e-12)],
+            "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("mean_iou", inputs=[IOSpec("Predictions", no_grad=True),
+                                 IOSpec("Labels", no_grad=True)],
+             outputs=["OutMeanIou", "OutWrong", "OutCorrect"],
+             attrs={"num_classes": 2}, grad=None)
+def _mean_iou(ctx, ins, attrs):
+    """reference mean_iou_op.h: mean IoU over classes present."""
+    pred = x(ins, "Predictions").reshape(-1).astype(jnp.int32)
+    lbl = x(ins, "Labels").reshape(-1).astype(jnp.int32)
+    nc = attrs["num_classes"]
+    inter = jnp.zeros((nc,), jnp.float32).at[
+        jnp.where(pred == lbl, pred, nc - 1)].add(
+        (pred == lbl).astype(jnp.float32))
+    area_p = jnp.zeros((nc,), jnp.float32).at[pred].add(1.0)
+    area_l = jnp.zeros((nc,), jnp.float32).at[lbl].add(1.0)
+    union = area_p + area_l - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.where(present, union, 1.0), 0.0)
+    miou = iou.sum() / jnp.maximum(present.sum(), 1)
+    # reference increments wrong for BOTH the predicted and true class on a
+    # mismatch, so accumulated correct+wrong reconstructs the union
+    miss = (pred != lbl).astype(jnp.float32)
+    wrong = (jnp.zeros((nc,), jnp.float32).at[pred].add(miss)
+             .at[lbl].add(miss)).astype(jnp.int32)
+    correct = inter.astype(jnp.int32)
+    return {"OutMeanIou": [miou], "OutWrong": [wrong],
+            "OutCorrect": [correct]}
+
+
+@register_op("precision_recall",
+             inputs=[IOSpec("MaxProbs", no_grad=True),
+                     IOSpec("Indices", no_grad=True),
+                     IOSpec("Labels", no_grad=True),
+                     IOSpec("Weights", optional=True, no_grad=True),
+                     IOSpec("StatesInfo", optional=True, no_grad=True)],
+             outputs=["BatchMetrics", "AccumMetrics", "AccumStatesInfo"],
+             attrs={"class_number": 2}, grad=None)
+def _precision_recall(ctx, ins, attrs):
+    """reference precision_recall_op.h: per-class TP/FP/FN stats ->
+    (macro/micro precision, recall, F1) for the batch and accumulated."""
+    idx = x(ins, "Indices").reshape(-1).astype(jnp.int32)
+    lbl = x(ins, "Labels").reshape(-1).astype(jnp.int32)
+    wts = x(ins, "Weights")
+    w = jnp.ones(idx.shape, jnp.float32) if wts is None \
+        else wts.reshape(-1).astype(jnp.float32)
+    nc = attrs["class_number"]
+    hit = (idx == lbl).astype(jnp.float32) * w
+    miss = (idx != lbl).astype(jnp.float32) * w
+    tp = jnp.zeros((nc,), jnp.float32).at[
+        jnp.where(idx == lbl, idx, 0)].add(hit)
+    fp = jnp.zeros((nc,), jnp.float32).at[idx].add(miss)
+    fn = jnp.zeros((nc,), jnp.float32).at[lbl].add(miss)
+    states = jnp.stack([tp, fp, jnp.zeros_like(tp), fn], axis=1)  # [C,4]
+    prev = x(ins, "StatesInfo")
+    acc_states = states if prev is None else states + prev
+
+    def metrics(s):
+        tp_, fp_, _, fn_ = s[:, 0], s[:, 1], s[:, 2], s[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / (tp_ + fp_ + 1e-12), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / (tp_ + fn_ + 1e-12), 0.0)
+        f1 = jnp.where(prec + rec > 0, 2 * prec * rec / (prec + rec + 1e-12),
+                       0.0)
+        macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+        tps, fps, fns = tp_.sum(), fp_.sum(), fn_.sum()
+        mp = jnp.where(tps + fps > 0, tps / (tps + fps + 1e-12), 0.0)
+        mr = jnp.where(tps + fns > 0, tps / (tps + fns + 1e-12), 0.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / (mp + mr + 1e-12), 0.0)
+        return jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+
+    return {"BatchMetrics": [metrics(states)],
+            "AccumMetrics": [metrics(acc_states)],
+            "AccumStatesInfo": [acc_states]}

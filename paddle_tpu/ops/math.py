@@ -38,6 +38,65 @@ unary("sin", jnp.sin)
 unary("cos", jnp.cos)
 unary("logsigmoid", jax.nn.log_sigmoid)
 unary("erf", jax.scipy.special.erf)
+unary("tan", jnp.tan)
+unary("asin", jnp.arcsin)
+unary("acos", jnp.arccos)
+unary("atan", jnp.arctan)
+unary("sinh", jnp.sinh)
+unary("cosh", jnp.cosh)
+unary("log1p", jnp.log1p)
+unary("expm1", jnp.expm1)
+unary("log2", jnp.log2)
+unary("log10", jnp.log10)
+unary("sign", jnp.sign, grad=None)
+unary("silu", jax.nn.silu)
+unary("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)))
+unary("selu", jax.nn.selu)
+
+
+@register_op("stanh", inputs=["X"], outputs=["Out"],
+             attrs={"scale_a": 0.67, "scale_b": 1.7159})
+def _stanh(ctx, ins, attrs):
+    return out(attrs["scale_b"] * jnp.tanh(attrs["scale_a"] * x(ins)))
+
+
+@register_op("brelu", inputs=["X"], outputs=["Out"],
+             attrs={"t_min": 0.0, "t_max": 24.0})
+def _brelu(ctx, ins, attrs):
+    return out(jnp.clip(x(ins), attrs["t_min"], attrs["t_max"]))
+
+
+@register_op("hard_shrink", inputs=["X"], outputs=["Out"],
+             attrs={"threshold": 0.5})
+def _hard_shrink(ctx, ins, attrs):
+    v, t = x(ins), attrs["threshold"]
+    return out(jnp.where(jnp.abs(v) > t, v, 0.0))
+
+
+@register_op("softshrink", inputs=["X"], outputs=["Out"],
+             attrs={"lambda": 0.5})
+def _softshrink(ctx, ins, attrs):
+    v, lam = x(ins), attrs["lambda"]
+    return out(jnp.where(v > lam, v - lam, jnp.where(v < -lam, v + lam, 0.0)))
+
+
+@register_op("thresholded_relu", inputs=["X"], outputs=["Out"],
+             attrs={"threshold": 1.0})
+def _thresholded_relu(ctx, ins, attrs):
+    v = x(ins)
+    return out(jnp.where(v > attrs["threshold"], v, 0.0))
+
+
+@register_op("maxout", inputs=["X"], outputs=["Out"],
+             attrs={"groups": 1, "axis": 1})
+def _maxout(ctx, ins, attrs):
+    """reference maxout_op.h: channels fold into groups, max within each."""
+    v, g = x(ins), attrs["groups"]
+    ax = attrs.get("axis", 1)
+    ax = ax if ax >= 0 else ax + v.ndim
+    c = v.shape[ax]
+    shp = v.shape[:ax] + (c // g, g) + v.shape[ax + 1:]
+    return out(v.reshape(shp).max(axis=ax + 1))
 
 
 @register_op("gelu", inputs=["X"], outputs=["Out"], attrs={"approximate": False})

@@ -1,0 +1,322 @@
+"""Recurrent ops: lstm (dynamic_lstm), gru (dynamic_gru), gru_unit,
+cudnn_lstm, warpctc — on the padded+lengths encoding.
+
+Reference kernels (gate math verified against the C++ sources):
+* lstm_op.h + math/detail/lstm_kernel.h — gate layout 4H = [c~, i, f, o]
+  (value_in at 0, input gate at H, forget at 2H, output at 3H); peephole
+  terms i += prev_c*checkI, f += prev_c*checkF, o += c*checkO; cell
+  c = c~*i + prev_c*f; h = o * act(c).
+* gru_op.h + math/detail/gru_kernel.h — gate layout 3H = [u, r, c~];
+  weight [H, 3H] splits into W_ur [H, 2H] and W_c [H, H]; candidate gate
+  += (r*prev) @ W_c; default (origin_mode=False) h = (1-u)*prev + u*c~.
+* cudnn_lstm_op.cu.cc — a whole multi-layer LSTM in one op; here the flat
+  weight packs per layer [W_ih (4H,in), W_hh (4H,H), b_ih (4H), b_hh (4H)]
+  and the loop is a stack of scans (the cuDNN black box becomes XLA-fused
+  scans).
+* warpctc_op.h — CTC loss; the external warp-ctc library becomes a
+  log-semiring forward DP under lax.scan, differentiable by jax.vjp (no
+  hand-written backward needed).
+
+All run batch-major padded [B, T, ...] with an int32 lengths array; steps
+past a sequence's length leave the carry unchanged (masked select), so
+final states equal the reference's LoD-packed results.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import IOSpec, out, register_op, x
+
+_ACT = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
+        "identity": lambda v: v}
+
+
+def _scan_outputs(step, carry, xs_tm, lengths):
+    """scan with per-step freeze once t >= len (padded steps are no-ops);
+    returns (final_carry, stacked_per_step_carries)."""
+    T = xs_tm.shape[0]
+
+    def body(c, inp):
+        t, xt = inp
+        new = step(c, xt)
+        keep = (t < lengths)[:, None]
+        sel = tuple(jnp.where(keep, n, o) for n, o in zip(new, c))
+        return sel, sel
+
+    final, stacked = jax.lax.scan(body, carry, (jnp.arange(T), xs_tm))
+    return final, stacked
+
+
+@register_op("lstm",
+             inputs=[IOSpec("Input"), IOSpec("Weight"),
+                     IOSpec("Bias", optional=True),
+                     IOSpec("H0", optional=True), IOSpec("C0", optional=True),
+                     IOSpec("SeqLen", no_grad=True)],
+             outputs=["Hidden", "Cell"],
+             attrs={"use_peepholes": True, "is_reverse": False,
+                    "gate_activation": "sigmoid",
+                    "cell_activation": "tanh",
+                    "candidate_activation": "tanh", "cell_clip": 0.0})
+def _lstm(ctx, ins, attrs):
+    """dynamic_lstm: Input [B,T,4H] is the pre-projected x@W_x (the
+    reference also takes projected input), Weight [H,4H] recurrent."""
+    xg = x(ins, "Input")
+    w = x(ins, "Weight")
+    bias = x(ins, "Bias")
+    ln = x(ins, "SeqLen")
+    B, T, H4 = xg.shape
+    H = H4 // 4
+    act_g = _ACT[attrs["gate_activation"]]
+    act_c = _ACT[attrs["cell_activation"]]
+    act_cand = _ACT[attrs["candidate_activation"]]
+    peep = attrs.get("use_peepholes", False) and bias is not None \
+        and bias.reshape(-1).shape[0] >= 7 * H
+    b = None if bias is None else bias.reshape(-1)
+    gate_b = None if b is None else b[:4 * H]
+    ckI = b[4 * H:5 * H] if peep else 0.0
+    ckF = b[5 * H:6 * H] if peep else 0.0
+    ckO = b[6 * H:7 * H] if peep else 0.0
+
+    h0 = x(ins, "H0")
+    c0 = x(ins, "C0")
+    h0 = jnp.zeros((B, H), xg.dtype) if h0 is None else h0
+    c0 = jnp.zeros((B, H), xg.dtype) if c0 is None else c0
+
+    xs = jnp.moveaxis(xg, 1, 0)  # [T,B,4H]
+    if attrs.get("is_reverse"):
+        # reverse each VALID prefix (padding stays at the tail)
+        t_idx = jnp.arange(T)[:, None]
+        src = jnp.where(t_idx < ln[None, :], ln[None, :] - 1 - t_idx, t_idx)
+        xs = jnp.take_along_axis(xs, src[:, :, None], axis=0)
+
+    def step(carry, xt):
+        h, c = carry
+        g = xt + h @ w
+        if gate_b is not None:
+            g = g + gate_b
+        cand = act_cand(g[:, :H])
+        i = act_g(g[:, H:2 * H] + c * ckI)
+        f = act_g(g[:, 2 * H:3 * H] + c * ckF)
+        new_c = cand * i + c * f
+        clip = attrs.get("cell_clip", 0.0)
+        if clip and clip > 0:
+            new_c = jnp.clip(new_c, -clip, clip)
+        o = act_g(g[:, 3 * H:] + new_c * ckO)
+        new_h = o * act_c(new_c)
+        return new_h, new_c
+
+    (hT, cT), (hs, _) = _scan_outputs(step, (h0, c0), xs, ln)
+    hidden = jnp.moveaxis(hs, 0, 1)  # [B,T,H]
+    if attrs.get("is_reverse"):
+        t_idx = jnp.arange(T)[None, :]
+        src = jnp.where(t_idx < ln[:, None], ln[:, None] - 1 - t_idx, t_idx)
+        hidden = jnp.take_along_axis(hidden, src[:, :, None], axis=1)
+    mask = (jnp.arange(T)[None, :] < ln[:, None])[..., None]
+    return {"Hidden": [jnp.where(mask, hidden, 0)], "Cell": [cT]}
+
+
+@register_op("gru",
+             inputs=[IOSpec("Input"), IOSpec("Weight"),
+                     IOSpec("Bias", optional=True),
+                     IOSpec("H0", optional=True),
+                     IOSpec("SeqLen", no_grad=True)],
+             outputs=["Hidden"],
+             attrs={"is_reverse": False, "origin_mode": False,
+                    "gate_activation": "sigmoid", "activation": "tanh"})
+def _gru(ctx, ins, attrs):
+    """dynamic_gru: Input [B,T,3H] pre-projected, Weight [H,3H]."""
+    xg, w, ln = x(ins, "Input"), x(ins, "Weight"), x(ins, "SeqLen")
+    bias = x(ins, "Bias")
+    B, T, H3 = xg.shape
+    H = H3 // 3
+    act_g = _ACT[attrs["gate_activation"]]
+    act_c = _ACT[attrs["activation"]]
+    w_ur, w_c = w[:, :2 * H], w[:, 2 * H:]
+    h0 = x(ins, "H0")
+    h = jnp.zeros((B, H), xg.dtype) if h0 is None else h0
+    xs = jnp.moveaxis(xg, 1, 0)
+    if bias is not None:
+        xs = xs + bias.reshape(-1)[None, None, :]
+    if attrs.get("is_reverse"):
+        t_idx = jnp.arange(T)[:, None]
+        src = jnp.where(t_idx < ln[None, :], ln[None, :] - 1 - t_idx, t_idx)
+        xs = jnp.take_along_axis(xs, src[:, :, None], axis=0)
+
+    def step(carry, xt):
+        (h_prev,) = carry
+        ur = xt[:, :2 * H] + h_prev @ w_ur
+        u = act_g(ur[:, :H])
+        r = act_g(ur[:, H:])
+        cand = act_c(xt[:, 2 * H:] + (r * h_prev) @ w_c)
+        if attrs.get("origin_mode"):
+            h_new = u * h_prev + cand - u * cand
+        else:
+            h_new = h_prev - u * h_prev + u * cand
+        return (h_new,)
+
+    (hT,), (hs,) = _scan_outputs(step, (h,), xs, ln)
+    hidden = jnp.moveaxis(hs, 0, 1)
+    if attrs.get("is_reverse"):
+        t_idx = jnp.arange(T)[None, :]
+        src = jnp.where(t_idx < ln[:, None], ln[:, None] - 1 - t_idx, t_idx)
+        hidden = jnp.take_along_axis(hidden, src[:, :, None], axis=1)
+    mask = (jnp.arange(T)[None, :] < ln[:, None])[..., None]
+    return {"Hidden": [jnp.where(mask, hidden, 0)]}
+
+
+@register_op("gru_unit",
+             inputs=[IOSpec("Input"), IOSpec("HiddenPrev"), IOSpec("Weight"),
+                     IOSpec("Bias", optional=True)],
+             outputs=["Gate", "ResetHiddenPrev", "Hidden"],
+             attrs={"activation": "tanh", "gate_activation": "sigmoid",
+                    "origin_mode": False})
+def _gru_unit(ctx, ins, attrs):
+    """One GRU step (reference gru_unit_op.h), same math as gru above."""
+    xt, h_prev, w = x(ins, "Input"), x(ins, "HiddenPrev"), x(ins, "Weight")
+    bias = x(ins, "Bias")
+    H = h_prev.shape[-1]
+    act_g = _ACT[attrs["gate_activation"]]
+    act_c = _ACT[attrs["activation"]]
+    if bias is not None:
+        xt = xt + bias.reshape(-1)[None, :]
+    ur = xt[:, :2 * H] + h_prev @ w[:, :2 * H]
+    u, r = act_g(ur[:, :H]), act_g(ur[:, H:])
+    reset_h = r * h_prev
+    cand = act_c(xt[:, 2 * H:] + reset_h @ w[:, 2 * H:])
+    if attrs.get("origin_mode"):
+        h_new = u * h_prev + cand - u * cand
+    else:
+        h_new = h_prev - u * h_prev + u * cand
+    gate = jnp.concatenate([u, r, cand], axis=1)
+    return {"Gate": [gate], "ResetHiddenPrev": [reset_h], "Hidden": [h_new]}
+
+
+@register_op("cudnn_lstm",
+             inputs=[IOSpec("Input"), IOSpec("W"),
+                     IOSpec("InitH", optional=True),
+                     IOSpec("InitC", optional=True),
+                     IOSpec("SeqLen", no_grad=True)],
+             outputs=["Out", "LastH", "LastC"],
+             attrs={"hidden_size": 0, "num_layers": 1,
+                    "dropout_prob": 0.0, "is_test": False},
+             needs_rng=True)
+def _cudnn_lstm(ctx, ins, attrs):
+    """Multi-layer LSTM in one op (reference cudnn_lstm_op.cu.cc). Input
+    [B,T,D]; flat W packs per layer [W_ih(4H,in), W_hh(4H,H), b_ih, b_hh],
+    gate order [c~, i, f, o] for consistency with the lstm op."""
+    xv, wflat, ln = x(ins, "Input"), x(ins, "W"), x(ins, "SeqLen")
+    B, T, D = xv.shape
+    H = attrs["hidden_size"]
+    L = attrs["num_layers"]
+    init_h, init_c = x(ins, "InitH"), x(ins, "InitC")
+    init_h = jnp.zeros((L, B, H), xv.dtype) if init_h is None else init_h
+    init_c = jnp.zeros((L, B, H), xv.dtype) if init_c is None else init_c
+
+    wflat = wflat.reshape(-1)
+    offset = 0
+    seq = xv
+    last_h, last_c = [], []
+    for layer in range(L):
+        in_dim = D if layer == 0 else H
+        n_wih = 4 * H * in_dim
+        n_whh = 4 * H * H
+        w_ih = wflat[offset:offset + n_wih].reshape(4 * H, in_dim)
+        offset += n_wih
+        w_hh = wflat[offset:offset + n_whh].reshape(4 * H, H)
+        offset += n_whh
+        b = wflat[offset:offset + 4 * H] + wflat[offset + 4 * H:
+                                                 offset + 8 * H]
+        offset += 8 * H
+
+        gates = jnp.einsum("btd,gd->btg", seq, w_ih) + b[None, None, :]
+        xs = jnp.moveaxis(gates, 1, 0)
+
+        def step(carry, xt, w_hh=w_hh, H=H):
+            h, c = carry
+            g = xt + h @ w_hh.T
+            cand = jnp.tanh(g[:, :H])
+            i = jax.nn.sigmoid(g[:, H:2 * H])
+            f = jax.nn.sigmoid(g[:, 2 * H:3 * H])
+            o = jax.nn.sigmoid(g[:, 3 * H:])
+            nc = cand * i + c * f
+            return o * jnp.tanh(nc), nc
+
+        (hT, cT), (hs, _) = _scan_outputs(step, (init_h[layer],
+                                                 init_c[layer]), xs, ln)
+        seq = jnp.moveaxis(hs, 0, 1)
+        if layer < L - 1 and attrs.get("dropout_prob", 0.0) > 0 \
+                and not attrs.get("is_test"):
+            keep = 1.0 - attrs["dropout_prob"]
+            mask = jax.random.bernoulli(ctx.rng(), keep, seq.shape)
+            seq = jnp.where(mask, seq / keep, 0)
+        last_h.append(hT)
+        last_c.append(cT)
+    mask = (jnp.arange(T)[None, :] < ln[:, None])[..., None]
+    return {"Out": [jnp.where(mask, seq, 0)],
+            "LastH": [jnp.stack(last_h)], "LastC": [jnp.stack(last_c)]}
+
+
+@register_op("warpctc",
+             inputs=[IOSpec("Logits"), IOSpec("Label", no_grad=True),
+                     IOSpec("LogitsLength", no_grad=True),
+                     IOSpec("LabelLength", no_grad=True)],
+             outputs=["Loss"],
+             attrs={"blank": 0, "norm_by_times": False})
+def _warpctc(ctx, ins, attrs):
+    """CTC loss (reference warpctc_op.h binding the warp-ctc library).
+
+    Log-semiring forward DP over the blank-extended label sequence under
+    lax.scan — differentiable through jax.vjp, so no custom backward.
+    Logits [B, T, C] unnormalised; Label [B, L] padded; per-sample lengths.
+    """
+    logits = x(ins, "Logits")
+    labels = x(ins, "Label").astype(jnp.int32)
+    tlen = x(ins, "LogitsLength").reshape(-1).astype(jnp.int32)
+    llen = x(ins, "LabelLength").reshape(-1).astype(jnp.int32)
+    blank = attrs.get("blank", 0)
+    B, T, C = logits.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    logp = jax.nn.log_softmax(logits, axis=-1)
+
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_valid = jnp.arange(S)[None, :] < (2 * llen + 1)[:, None]
+    # can-skip: ext[s] != blank and ext[s] != ext[s-2]
+    skip_ok = jnp.zeros((B, S), bool)
+    skip_ok = skip_ok.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    NEG = jnp.asarray(-1e30, logp.dtype)
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    first_lbl = jnp.take_along_axis(logp[:, 0, :], ext[:, 1:2], axis=1)[:, 0]
+    alpha0 = alpha0.at[:, 1].set(jnp.where(llen > 0, first_lbl, NEG))
+
+    def step(alpha, t):
+        stay = alpha
+        prev1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], 1)
+        prev2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], 1)
+        prev2 = jnp.where(skip_ok, prev2, NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        emit = jnp.take_along_axis(logp[:, t, :], ext, axis=1)
+        new = merged + emit
+        new = jnp.where(ext_valid, new, NEG)
+        # frames past a sample's length leave alpha unchanged
+        return jnp.where((t < tlen)[:, None], new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    # loss = -log(alpha[2*llen] + alpha[2*llen - 1])
+    idx_last = (2 * llen)[:, None]
+    idx_prev = jnp.maximum(2 * llen - 1, 0)[:, None]
+    a_last = jnp.take_along_axis(alpha, idx_last, axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, idx_prev, axis=1)[:, 0]
+    a_prev = jnp.where(llen > 0, a_prev, NEG)
+    ll = jnp.logaddexp(a_last, a_prev)
+    loss = -ll
+    if attrs.get("norm_by_times"):
+        loss = loss / jnp.maximum(tlen, 1).astype(loss.dtype)
+    return {"Loss": [loss.reshape(B, 1)]}

@@ -379,3 +379,97 @@ def _pad(ctx, ins, attrs):
     p = attrs["paddings"]
     cfg = [(p[2 * i], p[2 * i + 1]) for i in range(xv.ndim)]
     return out(jnp.pad(xv, cfg, constant_values=attrs["pad_value"]))
+
+
+@register_op("gather_nd", inputs=[IOSpec("X"), IOSpec("Index", no_grad=True)],
+             outputs=["Out"])
+def _gather_nd(ctx, ins, attrs):
+    """reference gather_nd_op.h: index's last dim addresses leading dims of
+    X; output = X[idx[..., 0], idx[..., 1], ...]."""
+    xv, idx = x(ins, "X"), x(ins, "Index")
+    flat_idx = tuple(jnp.moveaxis(idx, -1, 0).astype(jnp.int32))
+    return out(xv[flat_idx])
+
+
+@register_op("scatter_nd_add",
+             inputs=[IOSpec("X"), IOSpec("Index", no_grad=True),
+                     IOSpec("Updates")],
+             outputs=["Out"])
+def _scatter_nd_add(ctx, ins, attrs):
+    xv, idx, upd = x(ins, "X"), x(ins, "Index"), x(ins, "Updates")
+    flat_idx = tuple(jnp.moveaxis(idx, -1, 0).astype(jnp.int32))
+    return out(xv.at[flat_idx].add(upd))
+
+
+@register_op("reverse", inputs=[IOSpec("X")], outputs=["Out"],
+             attrs={"axis": [0]})
+def _reverse(ctx, ins, attrs):
+    ax = attrs["axis"]
+    ax = [ax] if isinstance(ax, int) else list(ax)
+    return out(jnp.flip(x(ins), axis=tuple(ax)))
+
+
+@register_op("expand_as", inputs=[IOSpec("X"),
+                                  IOSpec("target_tensor", no_grad=True)],
+             outputs=["Out"])
+def _expand_as(ctx, ins, attrs):
+    xv, ref = x(ins, "X"), x(ins, "target_tensor")
+    reps = tuple(int(t // s) for s, t in zip(xv.shape, ref.shape))
+    return out(jnp.tile(xv, reps))
+
+
+@register_op("diag", inputs=[IOSpec("Diagonal", no_grad=True)],
+             outputs=["Out"], grad=None)
+def _diag(ctx, ins, attrs):
+    return out(jnp.diag(x(ins, "Diagonal")))
+
+
+@register_op("eye", outputs=["Out"],
+             attrs={"num_rows": 0, "num_columns": -1, "dtype": "float32"},
+             grad=None)
+def _eye(ctx, ins, attrs):
+    n = attrs["num_rows"]
+    m = attrs["num_columns"]
+    m = n if m is None or m < 0 else m
+    return out(jnp.eye(n, m, dtype=np_dtype(attrs["dtype"])))
+
+
+@register_op("pad2d", inputs=[IOSpec("X")], outputs=["Out"],
+             attrs={"paddings": [0, 0, 0, 0], "mode": "constant",
+                    "pad_value": 0.0, "data_format": "NCHW"})
+def _pad2d(ctx, ins, attrs):
+    """reference pad2d_op.cc: NCHW spatial padding [top,bottom,left,right],
+    constant/reflect/edge modes."""
+    xv = x(ins)
+    t, b, l, r = attrs["paddings"]
+    if attrs.get("data_format", "NCHW") == "NHWC":
+        width = [(0, 0), (t, b), (l, r), (0, 0)]
+    else:
+        width = [(0, 0), (0, 0), (t, b), (l, r)]
+    mode = attrs["mode"]
+    if mode == "constant":
+        return out(jnp.pad(xv, width, constant_values=attrs["pad_value"]))
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return out(jnp.pad(xv, width, mode=jmode))
+
+
+@register_op("roll", inputs=[IOSpec("X")], outputs=["Out"],
+             attrs={"shifts": [0], "axis": []})
+def _roll(ctx, ins, attrs):
+    ax = attrs.get("axis") or None
+    return out(jnp.roll(x(ins), tuple(attrs["shifts"]),
+                        axis=tuple(ax) if ax else None))
+
+
+@register_op("shard_index", inputs=[IOSpec("X", no_grad=True)],
+             outputs=["Out"],
+             attrs={"index_num": 0, "nshards": 1, "shard_id": 0,
+                    "ignore_value": -1}, grad=None)
+def _shard_index(ctx, ins, attrs):
+    """reference shard_index_op.h: map global ids to shard-local ids."""
+    v = x(ins)
+    per = (attrs["index_num"] + attrs["nshards"] - 1) // attrs["nshards"]
+    sid = attrs["shard_id"]
+    local = v - sid * per
+    ok = (v // per) == sid
+    return out(jnp.where(ok, local, attrs["ignore_value"]))

@@ -1,0 +1,204 @@
+"""Detection ops vs numpy oracles implementing the reference kernels
+(operators/detection/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+RNG = np.random.RandomState(11)
+
+
+def _np_iou(a, b):
+    area = lambda bx: np.maximum(bx[:, 2] - bx[:, 0], 0) * \
+        np.maximum(bx[:, 3] - bx[:, 1], 0)
+    n, m = len(a), len(b)
+    res = np.zeros((n, m), np.float32)
+    for i in range(n):
+        for j in range(m):
+            ix0 = max(a[i, 0], b[j, 0]); iy0 = max(a[i, 1], b[j, 1])
+            ix1 = min(a[i, 2], b[j, 2]); iy1 = min(a[i, 3], b[j, 3])
+            inter = max(ix1 - ix0, 0) * max(iy1 - iy0, 0)
+            u = area(a[i:i+1])[0] + area(b[j:j+1])[0] - inter
+            res[i, j] = inter / u if u > 0 else 0.0
+    return res
+
+
+def _boxes(n, scale=1.0):
+    xy = RNG.rand(n, 2).astype(np.float32) * 0.6 * scale
+    wh = (RNG.rand(n, 2).astype(np.float32) * 0.3 + 0.05) * scale
+    return np.concatenate([xy, xy + wh], 1).astype(np.float32)
+
+
+class TestIouSimilarity(OpTest):
+    def setup(self):
+        a, b = _boxes(5), _boxes(7)
+        self.op_type = "iou_similarity"
+        self.inputs = {"X": a, "Y": b}
+        self.outputs = {"Out": _np_iou(a, b)}
+
+    def test(self):
+        self.check_output(rtol=1e-5, atol=1e-6)
+
+
+class TestPriorBox(OpTest):
+    def setup(self):
+        feat = RNG.randn(1, 8, 4, 4).astype(np.float32)
+        img = RNG.randn(1, 3, 64, 64).astype(np.float32)
+        mins, maxs, ars = [20.0], [40.0], [2.0]
+        # numpy oracle straight from prior_box_op.h default order
+        exp_ars = [1.0, 2.0, 0.5]  # flip=True expansion
+        step = 16.0
+        P = len(exp_ars) + 1
+        boxes = np.zeros((4, 4, P, 4), np.float32)
+        for h in range(4):
+            for w in range(4):
+                cx, cy = (w + 0.5) * step, (h + 0.5) * step
+                p = 0
+                for ar in exp_ars:
+                    bw = mins[0] * np.sqrt(ar) / 2
+                    bh = mins[0] / np.sqrt(ar) / 2
+                    boxes[h, w, p] = [(cx - bw) / 64, (cy - bh) / 64,
+                                      (cx + bw) / 64, (cy + bh) / 64]
+                    p += 1
+                s = np.sqrt(mins[0] * maxs[0]) / 2
+                boxes[h, w, p] = [(cx - s) / 64, (cy - s) / 64,
+                                  (cx + s) / 64, (cy + s) / 64]
+        var = np.broadcast_to(np.array([0.1, 0.1, 0.2, 0.2], np.float32),
+                              boxes.shape)
+        self.op_type = "prior_box"
+        self.inputs = {"Input": feat, "Image": img}
+        self.attrs = {"min_sizes": mins, "max_sizes": maxs,
+                      "aspect_ratios": ars, "flip": True}
+        self.outputs = {"Boxes": boxes, "Variances": np.array(var)}
+
+    def test(self):
+        self.check_output(rtol=1e-5, atol=1e-6)
+
+
+class TestBoxCoderDecode(OpTest):
+    def setup(self):
+        prior = _boxes(6, scale=10)
+        pvar = (RNG.rand(6, 4).astype(np.float32) * 0.2 + 0.05)
+        deltas = (RNG.randn(3, 6, 4) * 0.2).astype(np.float32)
+        wantd = np.zeros((3, 6, 4), np.float32)
+        pw = prior[:, 2] - prior[:, 0]
+        ph = prior[:, 3] - prior[:, 1]
+        pcx = prior[:, 0] + pw / 2
+        pcy = prior[:, 1] + ph / 2
+        for i in range(3):
+            for j in range(6):
+                d = deltas[i, j] * pvar[j]
+                cx = d[0] * pw[j] + pcx[j]
+                cy = d[1] * ph[j] + pcy[j]
+                w = np.exp(d[2]) * pw[j]
+                h = np.exp(d[3]) * ph[j]
+                wantd[i, j] = [cx - w / 2, cy - h / 2, cx + w / 2,
+                               cy + h / 2]
+        self.op_type = "box_coder"
+        self.inputs = {"PriorBox": prior, "PriorBoxVar": pvar,
+                       "TargetBox": deltas}
+        self.attrs = {"code_type": "decode_center_size"}
+        self.outputs = {"OutputBox": wantd}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+
+
+class TestYoloBox(OpTest):
+    def setup(self):
+        an, cls, H = 2, 3, 2
+        anchors = [10, 14, 23, 27]
+        xv = (RNG.randn(1, an * (5 + cls), H, H) * 0.5).astype(np.float32)
+        img = np.array([[128, 128]], np.int32)
+        down = 32
+        v = xv.reshape(1, an, 5 + cls, H, H)
+        sig = lambda z: 1 / (1 + np.exp(-z))
+        boxes = np.zeros((1, an * H * H, 4), np.float32)
+        scores = np.zeros((1, an * H * H, cls), np.float32)
+        k = 0
+        for a in range(an):
+            for gy in range(H):
+                for gx in range(H):
+                    bx = (gx + sig(v[0, a, 0, gy, gx])) * 128 / H
+                    by = (gy + sig(v[0, a, 1, gy, gx])) * 128 / H
+                    bw = np.exp(v[0, a, 2, gy, gx]) * anchors[2 * a] * 128 \
+                        / (down * H)
+                    bh = np.exp(v[0, a, 3, gy, gx]) * anchors[2 * a + 1] \
+                        * 128 / (down * H)
+                    conf = sig(v[0, a, 4, gy, gx])
+                    keep = conf >= 0.005
+                    box = [max(bx - bw / 2, 0), max(by - bh / 2, 0),
+                           min(bx + bw / 2, 127), min(by + bh / 2, 127)]
+                    boxes[0, k] = [b * keep for b in box]
+                    scores[0, k] = sig(v[0, a, 5:, gy, gx]) * conf * keep
+                    k += 1
+        self.op_type = "yolo_box"
+        self.inputs = {"X": xv, "ImgSize": img}
+        self.attrs = {"anchors": anchors, "class_num": cls,
+                      "conf_thresh": 0.005, "downsample_ratio": down}
+        self.outputs = {"Boxes": boxes, "Scores": scores}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    """Two heavily overlapping boxes + one separate: NMS keeps 2 per
+    class; padding rows are -1."""
+    bboxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                        [20, 20, 30, 30]]], np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)  # 1 class [N,C,M]
+    scores = np.concatenate([np.zeros_like(scores), scores], 1)  # bg + c1
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = fluid.layers.data("b", shape=[3, 4], dtype="float32")
+        s = fluid.layers.data("s", shape=[2, 3], dtype="float32")
+        o = fluid.layers.detection.multiclass_nms(
+            b, s, score_threshold=0.05, nms_top_k=3, keep_top_k=3,
+            nms_threshold=0.5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (got,) = exe.run(main, feed={"b": bboxes, "s": scores},
+                         fetch_list=[o.name])
+    got = np.asarray(got)[0]
+    kept = got[got[:, 0] >= 0]
+    assert len(kept) == 2
+    # highest score first; the 0.8 overlap was suppressed
+    np.testing.assert_allclose(kept[0, 1], 0.9, rtol=1e-5)
+    np.testing.assert_allclose(kept[1, 1], 0.7, rtol=1e-5)
+    np.testing.assert_allclose(kept[1, 2:], [20, 20, 30, 30], rtol=1e-5)
+    assert (got[2] == -1).all()
+
+
+def test_roi_align_and_pool_shapes_and_values():
+    feat = np.arange(2 * 1 * 4 * 4, dtype=np.float32).reshape(2, 1, 4, 4)
+    rois = np.array([[0, 0, 3, 3], [1, 1, 3, 3]], np.float32)
+    bidx = np.array([0, 1], np.int32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[1, 4, 4], dtype="float32")
+        rv = fluid.layers.data("r", shape=[-1, 4], dtype="float32",
+                               append_batch_size=False)
+        bi = fluid.layers.data("bi", shape=[-1], dtype="int32",
+                               append_batch_size=False)
+        al = fluid.layers.detection.roi_align(xv, rv, 2, 2,
+                                              rois_batch_idx=bi)
+        pl = fluid.layers.detection.roi_pool(xv, rv, 2, 2,
+                                             rois_batch_idx=bi)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        a, p = exe.run(main, feed={"x": feat, "r": rois, "bi": bidx},
+                       fetch_list=[al.name, pl.name])
+    assert np.asarray(a).shape == (2, 1, 2, 2)
+    assert np.asarray(p).shape == (2, 1, 2, 2)
+    # roi_pool on image 0, roi (0,0,3,3): quantized bins over 4x4 grid
+    np.testing.assert_allclose(np.asarray(p)[0, 0],
+                               [[5.0, 7.0], [13.0, 15.0]])
+    # align values sit inside the feature's range and grow along the roi
+    av = np.asarray(a)[0, 0]
+    assert av[0, 0] < av[1, 1] and 0 <= av.min() and av.max() <= 15

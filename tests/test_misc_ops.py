@@ -1,0 +1,220 @@
+"""Batch coverage: new activations, tensor utilities, losses, metrics ops,
+and the distributions module."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+RNG = np.random.RandomState(5)
+
+
+class _Unary(OpTest):
+    op = None
+    fn = None
+    attrs_ = {}
+
+    def setup(self):
+        xv = RNG.randn(3, 7).astype(np.float32) * 0.8
+        self.op_type = self.op
+        self.inputs = {"X": xv}
+        self.attrs = dict(self.attrs_)
+        self.outputs = {"Out": self.fn(xv)}
+
+    def test(self):
+        self.check_output(rtol=1e-5, atol=1e-6)
+
+
+class TestTan(_Unary):
+    op, fn = "tan", staticmethod(np.tan)
+
+
+class TestMish(_Unary):
+    op = "mish"
+    fn = staticmethod(lambda v: v * np.tanh(np.log1p(np.exp(v))))
+
+
+class TestStanh(_Unary):
+    op = "stanh"
+    fn = staticmethod(lambda v: 1.7159 * np.tanh(0.67 * v))
+
+
+class TestSoftshrink(_Unary):
+    op = "softshrink"
+    attrs_ = {"lambda": 0.5}
+    fn = staticmethod(lambda v: np.where(v > 0.5, v - 0.5,
+                                         np.where(v < -0.5, v + 0.5, 0)))
+
+
+class TestMaxout(OpTest):
+    def setup(self):
+        xv = RNG.randn(2, 6, 4).astype(np.float32)
+        self.op_type = "maxout"
+        self.inputs = {"X": xv}
+        self.attrs = {"groups": 3, "axis": 1}
+        self.outputs = {"Out": xv.reshape(2, 2, 3, 4).max(2)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestGatherNd(OpTest):
+    def setup(self):
+        xv = RNG.randn(4, 5, 6).astype(np.float32)
+        idx = np.array([[0, 1], [3, 4]], np.int64)
+        self.op_type = "gather_nd"
+        self.inputs = {"X": xv, "Index": idx}
+        self.outputs = {"Out": np.stack([xv[0, 1], xv[3, 4]])}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestPad2dReflect(OpTest):
+    def setup(self):
+        xv = RNG.randn(1, 2, 4, 4).astype(np.float32)
+        self.op_type = "pad2d"
+        self.inputs = {"X": xv}
+        self.attrs = {"paddings": [1, 1, 2, 0], "mode": "reflect"}
+        self.outputs = {"Out": np.pad(
+            xv, [(0, 0), (0, 0), (1, 1), (2, 0)], mode="reflect")}
+
+    def test(self):
+        self.check_output()
+
+
+class TestKLDiv(OpTest):
+    def setup(self):
+        p = np.abs(RNG.rand(4, 6).astype(np.float32)) + 0.1
+        p = p / p.sum(-1, keepdims=True)
+        logq = np.log(np.abs(RNG.rand(4, 6).astype(np.float32)) + 0.1)
+        want = (p * (np.log(p) - logq)).mean()
+        self.op_type = "kldiv_loss"
+        self.inputs = {"X": logq, "Target": p}
+        self.attrs = {"reduction": "mean"}
+        self.outputs = {"Loss": np.float32(want)}
+
+    def test(self):
+        self.check_output(rtol=1e-5, atol=1e-6)
+        self.check_grad(["X"], "Loss")
+
+
+class TestCosSim(OpTest):
+    def setup(self):
+        a = RNG.randn(5, 8).astype(np.float32)
+        b = RNG.randn(5, 8).astype(np.float32)
+        want = (a * b).sum(-1, keepdims=True) / (
+            np.linalg.norm(a, axis=-1, keepdims=True) *
+            np.linalg.norm(b, axis=-1, keepdims=True))
+        self.op_type = "cos_sim"
+        self.inputs = {"X": a, "Y": b}
+        self.outputs = {"Out": want.astype(np.float32)}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5,
+                          no_check=("XNorm", "YNorm"))
+
+
+def test_precision_recall_binary():
+    idx = np.array([[1], [0], [1], [1]], np.int64)
+    lbl = np.array([[1], [0], [0], [1]], np.int64)
+    probs = np.ones((4, 1), np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.data("i", shape=[1], dtype="int64")
+        l = fluid.layers.data("l", shape=[1], dtype="int64")
+        p = fluid.layers.data("p", shape=[1], dtype="float32")
+        blk = main.global_block
+        bm = blk.create_var(name="bm", dtype="float32")
+        am = blk.create_var(name="am", dtype="float32")
+        st = blk.create_var(name="st", dtype="float32")
+        blk.append_op("precision_recall",
+                      inputs={"MaxProbs": "p", "Indices": "i", "Labels": "l"},
+                      outputs={"BatchMetrics": "bm", "AccumMetrics": "am",
+                               "AccumStatesInfo": "st"},
+                      attrs={"class_number": 2})
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (m,) = exe.run(main, feed={"i": idx, "l": lbl, "p": probs},
+                       fetch_list=["bm"])
+    m = np.asarray(m)
+    # micro: TP=3 (c1:2, c0:1), FP=1, FN=1 -> P=R=0.75
+    np.testing.assert_allclose(m[3], 0.75, rtol=1e-5)
+    np.testing.assert_allclose(m[4], 0.75, rtol=1e-5)
+
+
+def test_mean_iou():
+    pred = np.array([0, 0, 1, 1], np.int64)
+    lbl = np.array([0, 1, 1, 1], np.int64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = fluid.layers.data("p", shape=[-1], dtype="int64",
+                              append_batch_size=False)
+        l = fluid.layers.data("l", shape=[-1], dtype="int64",
+                              append_batch_size=False)
+        blk = main.global_block
+        for n in ("miou", "wrong", "correct"):
+            blk.create_var(name=n, dtype="float32")
+        blk.append_op("mean_iou", inputs={"Predictions": "p", "Labels": "l"},
+                      outputs={"OutMeanIou": "miou", "OutWrong": "wrong",
+                               "OutCorrect": "correct"},
+                      attrs={"num_classes": 2})
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (miou,) = exe.run(main, feed={"p": pred, "l": lbl},
+                          fetch_list=["miou"])
+    # class0: i=1 u=2 -> 0.5 ; class1: i=2 u=3 -> 2/3 ; mean = 7/12
+    np.testing.assert_allclose(float(np.asarray(miou)), 7 / 12, rtol=1e-5)
+
+
+def test_distributions():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        from paddle_tpu.layers.distributions import Categorical, Normal, Uniform
+
+        n1 = Normal(0.0, 1.0)
+        n2 = Normal(1.0, 2.0)
+        val = fluid.layers.data("v", shape=[1], dtype="float32")
+        lp = n1.log_prob(val)
+        ent = n2.entropy()
+        kl = n1.kl_divergence(n2)
+        u = Uniform(0.0, 2.0)
+        ue = u.entropy()
+        logits = fluid.layers.data("lg", shape=[3], dtype="float32")
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        c = Categorical(logits)
+        ce = c.entropy()
+        clp = c.log_prob(ids)
+        sample = n1.sample([4, 2], seed=7)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feed = {"v": np.array([[0.5]], np.float32),
+            "lg": np.array([[1.0, 2.0, 0.0]], np.float32),
+            "ids": np.array([[1]], np.int64)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        vals = exe.run(main, feed=feed,
+                       fetch_list=[lp.name, ent.name, kl.name, ue.name,
+                                   ce.name, clp.name, sample.name])
+    lp_, ent_, kl_, ue_, ce_, clp_, s_ = [np.asarray(v) for v in vals]
+    np.testing.assert_allclose(
+        lp_.reshape(-1)[0], -0.5 * 0.25 - 0.5 * np.log(2 * np.pi), rtol=1e-5)
+    np.testing.assert_allclose(
+        ent_.reshape(-1)[0], np.log(2.0) + 0.5 + 0.5 * np.log(2 * np.pi),
+        rtol=1e-5)
+    # KL(N(0,1) || N(1,2)) = log(2) + (1 + 1)/8 - 0.5
+    np.testing.assert_allclose(kl_.reshape(-1)[0],
+                               np.log(2.0) + 2 / 8 - 0.5, rtol=1e-5)
+    np.testing.assert_allclose(ue_.reshape(-1)[0], np.log(2.0), rtol=1e-6)
+    z = np.array([1.0, 2.0, 0.0])
+    p = np.exp(z - z.max()); p /= p.sum()
+    np.testing.assert_allclose(ce_.reshape(-1)[0], -(p * np.log(p)).sum(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(clp_.reshape(-1)[0], np.log(p[1]), rtol=1e-5)
+    assert s_.shape == (4, 2) and np.isfinite(s_).all()

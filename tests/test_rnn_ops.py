@@ -1,0 +1,256 @@
+"""RNN ops (lstm/gru/gru_unit/cudnn_lstm) + warpctc against numpy oracles
+implementing the reference kernels' math (lstm_kernel.h / gru_kernel.h /
+the CTC forward algorithm)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+def _sig(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def _np_lstm(xg, w, bias, lens, peep=True):
+    """Gate layout [c~, i, f, o]; returns padded hidden + last cell."""
+    B, T, H4 = xg.shape
+    H = H4 // 4
+    b = bias.reshape(-1)
+    gate_b = b[:4 * H]
+    ckI = b[4 * H:5 * H] if peep else 0.0
+    ckF = b[5 * H:6 * H] if peep else 0.0
+    ckO = b[6 * H:7 * H] if peep else 0.0
+    hid = np.zeros((B, T, H), np.float32)
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    cT = np.zeros((B, H), np.float32)
+    for bi in range(B):
+        h_, c_ = h[bi], c[bi]
+        for t in range(int(lens[bi])):
+            g = xg[bi, t] + h_ @ w + gate_b
+            cand = np.tanh(g[:H])
+            i = _sig(g[H:2 * H] + c_ * ckI)
+            f = _sig(g[2 * H:3 * H] + c_ * ckF)
+            nc = cand * i + c_ * f
+            o = _sig(g[3 * H:] + nc * ckO)
+            h_ = o * np.tanh(nc)
+            c_ = nc
+            hid[bi, t] = h_
+        cT[bi] = c_
+    return hid, cT
+
+
+def _np_gru(xg, w, bias, lens):
+    B, T, H3 = xg.shape
+    H = H3 // 3
+    b = bias.reshape(-1)
+    hid = np.zeros((B, T, H), np.float32)
+    for bi in range(B):
+        h = np.zeros(H, np.float32)
+        for t in range(int(lens[bi])):
+            xt = xg[bi, t] + b
+            ur = xt[:2 * H] + h @ w[:, :2 * H]
+            u, r = _sig(ur[:H]), _sig(ur[H:])
+            cand = np.tanh(xt[2 * H:] + (r * h) @ w[:, 2 * H:])
+            h = h - u * h + u * cand
+            hid[bi, t] = h
+    return hid
+
+
+RNG = np.random.RandomState(3)
+LENS = np.array([4, 2, 6], np.int32)
+T, B, H = 6, 3, 5
+
+
+class TestLSTM(OpTest):
+    def setup(self):
+        xg = (RNG.randn(B, T, 4 * H) * 0.5).astype(np.float32)
+        w = (RNG.randn(H, 4 * H) * 0.3).astype(np.float32)
+        bias = (RNG.randn(1, 7 * H) * 0.1).astype(np.float32)
+        hid, cT = _np_lstm(xg, w, bias, LENS)
+        self.op_type = "lstm"
+        self.inputs = {"Input": xg, "Weight": w, "Bias": bias,
+                       "SeqLen": LENS}
+        self.attrs = {"use_peepholes": True}
+        self.outputs = {"Hidden": hid, "Cell": cT}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+        self.check_grad(["Input", "Weight"], "Hidden",
+                        max_relative_error=0.02)
+
+
+class TestLSTMReverse(OpTest):
+    def setup(self):
+        xg = (RNG.randn(B, T, 4 * H) * 0.5).astype(np.float32)
+        w = (RNG.randn(H, 4 * H) * 0.3).astype(np.float32)
+        bias = (RNG.randn(1, 4 * H) * 0.1).astype(np.float32)
+        # oracle: reverse valid prefixes, run forward, reverse back
+        xr = xg.copy()
+        for bi in range(B):
+            L = int(LENS[bi])
+            xr[bi, :L] = xg[bi, :L][::-1]
+        hid_r, _ = _np_lstm(xr, w, bias, LENS, peep=False)
+        hid = hid_r.copy()
+        for bi in range(B):
+            L = int(LENS[bi])
+            hid[bi, :L] = hid_r[bi, :L][::-1]
+        self.op_type = "lstm"
+        self.inputs = {"Input": xg, "Weight": w, "Bias": bias,
+                       "SeqLen": LENS}
+        self.attrs = {"use_peepholes": False, "is_reverse": True}
+        self.outputs = {"Hidden": hid}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5, no_check=("Cell",))
+
+
+class TestGRU(OpTest):
+    def setup(self):
+        xg = (RNG.randn(B, T, 3 * H) * 0.5).astype(np.float32)
+        w = (RNG.randn(H, 3 * H) * 0.3).astype(np.float32)
+        bias = (RNG.randn(1, 3 * H) * 0.1).astype(np.float32)
+        hid = _np_gru(xg, w, bias, LENS)
+        self.op_type = "gru"
+        self.inputs = {"Input": xg, "Weight": w, "Bias": bias,
+                       "SeqLen": LENS}
+        self.outputs = {"Hidden": hid}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+        self.check_grad(["Input", "Weight"], "Hidden",
+                        max_relative_error=0.02)
+
+
+class TestGRUUnit(OpTest):
+    def setup(self):
+        xt = (RNG.randn(B, 3 * H) * 0.5).astype(np.float32)
+        hp = (RNG.randn(B, H) * 0.5).astype(np.float32)
+        w = (RNG.randn(H, 3 * H) * 0.3).astype(np.float32)
+        ur = xt[:, :2 * H] + hp @ w[:, :2 * H]
+        u, r = _sig(ur[:, :H]), _sig(ur[:, H:])
+        cand = np.tanh(xt[:, 2 * H:] + (r * hp) @ w[:, 2 * H:])
+        h = hp - u * hp + u * cand
+        self.op_type = "gru_unit"
+        self.inputs = {"Input": xt, "HiddenPrev": hp, "Weight": w}
+        self.outputs = {"Hidden": h,
+                        "Gate": np.concatenate([u, r, cand], 1),
+                        "ResetHiddenPrev": r * hp}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+        self.check_grad(["Input", "HiddenPrev", "Weight"], "Hidden",
+                        max_relative_error=0.02)
+
+
+def _np_ctc_loss(logits, labels, tlen, llen, blank=0):
+    """Textbook CTC forward algorithm in probability space."""
+    def softmax(z):
+        e = np.exp(z - z.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    out = []
+    for b in range(logits.shape[0]):
+        p = softmax(logits[b, :int(tlen[b])])
+        lab = labels[b, :int(llen[b])]
+        ext = [blank]
+        for l in lab:
+            ext += [int(l), blank]
+        S = len(ext)
+        a = np.zeros((int(tlen[b]), S))
+        a[0, 0] = p[0, blank]
+        if S > 1:
+            a[0, 1] = p[0, ext[1]]
+        for t in range(1, int(tlen[b])):
+            for s in range(S):
+                tot = a[t - 1, s]
+                if s >= 1:
+                    tot += a[t - 1, s - 1]
+                if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                    tot += a[t - 1, s - 2]
+                a[t, s] = tot * p[t, ext[s]]
+        ll = a[-1, S - 1] + (a[-1, S - 2] if S > 1 else 0.0)
+        out.append(-np.log(max(ll, 1e-300)))
+    return np.array(out, np.float32).reshape(-1, 1)
+
+
+class TestWarpCTC(OpTest):
+    def setup(self):
+        Bc, Tc, C, L = 3, 8, 6, 3
+        logits = (RNG.randn(Bc, Tc, C) * 2).astype(np.float32)
+        labels = RNG.randint(1, C, (Bc, L)).astype(np.int64)
+        tlen = np.array([8, 6, 7], np.int32)
+        llen = np.array([3, 1, 2], np.int32)
+        self.op_type = "warpctc"
+        self.inputs = {"Logits": logits, "Label": labels,
+                       "LogitsLength": tlen, "LabelLength": llen}
+        self.attrs = {"blank": 0}
+        self.outputs = {"Loss": _np_ctc_loss(logits, labels, tlen, llen)}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-4)
+        # fp32 central differences on a ~10-valued loss have ~1e-4 noise;
+        # CTC grads here are ~1e-3, so use a larger delta + loose rel bound
+        self.check_grad(["Logits"], "Loss", delta=0.02,
+                        max_relative_error=0.06)
+
+
+def test_cudnn_lstm_matches_stacked_reference():
+    """2-layer cudnn_lstm == manually stacking the numpy LSTM oracle with
+    the flat-weight packing."""
+    import jax
+
+    D, Hs, L = 4, 5, 2
+    lens = np.array([5, 3], np.int32)
+    xv = (RNG.randn(2, 6, D) * 0.5).astype(np.float32)
+    pieces, np_weights = [], []
+    for layer in range(L):
+        ind = D if layer == 0 else Hs
+        w_ih = (RNG.randn(4 * Hs, ind) * 0.3).astype(np.float32)
+        w_hh = (RNG.randn(4 * Hs, Hs) * 0.3).astype(np.float32)
+        b_ih = (RNG.randn(4 * Hs) * 0.1).astype(np.float32)
+        b_hh = (RNG.randn(4 * Hs) * 0.1).astype(np.float32)
+        pieces += [w_ih.ravel(), w_hh.ravel(), b_ih, b_hh]
+        np_weights.append((w_ih, w_hh, b_ih + b_hh))
+    wflat = np.concatenate(pieces)
+
+    # numpy stacked reference: gates = x@W_ih^T + b; recurrent h@W_hh^T
+    seq = xv
+    for w_ih, w_hh, b in np_weights:
+        out = np.zeros((2, 6, Hs), np.float32)
+        for bi in range(2):
+            h = np.zeros(Hs, np.float32)
+            c = np.zeros(Hs, np.float32)
+            for t in range(int(lens[bi])):
+                g = seq[bi, t] @ w_ih.T + h @ w_hh.T + b
+                cand = np.tanh(g[:Hs])
+                i = _sig(g[Hs:2 * Hs])
+                f = _sig(g[2 * Hs:3 * Hs])
+                o = _sig(g[3 * Hs:])
+                c = cand * i + c * f
+                h = o * np.tanh(c)
+                out[bi, t] = h
+        seq = out
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block
+        mk = lambda n, a: blk.create_var(name=n, shape=a.shape,
+                                         dtype=str(a.dtype), is_data=True)
+        vx, vw = mk("x", xv), mk("w", wflat)
+        vl = mk("lens", lens)
+        o1 = blk.create_var(name="o1", dtype="float32")
+        o2 = blk.create_var(name="o2", dtype="float32")
+        o3 = blk.create_var(name="o3", dtype="float32")
+        blk.append_op("cudnn_lstm",
+                      inputs={"Input": "x", "W": "w", "SeqLen": "lens"},
+                      outputs={"Out": "o1", "LastH": "o2", "LastC": "o3"},
+                      attrs={"hidden_size": Hs, "num_layers": L})
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (got,) = exe.run(main, feed={"x": xv, "w": wflat, "lens": lens},
+                         fetch_list=["o1"])
+    np.testing.assert_allclose(got, seq, rtol=1e-4, atol=1e-5)
