@@ -23,6 +23,7 @@ from . import dataset
 from . import distributed
 from . import dygraph
 from . import incubate
+from . import inference
 from . import io
 from . import reader
 from .data_feeder import DataFeeder

@@ -97,6 +97,10 @@ def _make_grad_op(op, out_grad: Dict[str, List[str]],
         inputs["__out__" + slot] = list(names)
     inputs.update(out_grad)
     attrs = dict(op.attrs)
+    # the grad op must get ITS OWN role/uid stamps — inheriting the
+    # forward's '__op_role__' would make clone(for_test=True) keep grad ops
+    attrs.pop("__op_role__", None)
+    attrs.pop("__uid__", None)
     attrs["__fwd_type__"] = op.type
     attrs["__fwd_uid__"] = op.attrs.get("__uid__", 0)
     return {"type": op.type + "_grad", "inputs": inputs,

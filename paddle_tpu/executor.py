@@ -24,7 +24,7 @@ import numpy as np
 
 from .core.types import np_dtype
 from .framework import Program, Variable, default_main_program
-from .lowering import LowerCtx, lower_block
+from .lowering import LowerCtx, lower_block, lower_op
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard", "CPUPlace",
            "TPUPlace", "CUDAPlace"]
@@ -233,6 +233,119 @@ def unpack_step_result(step, result, scope, to_host=np.asarray):
     return fetches, new_state
 
 
+def make_pipeline_step_fn(block, io: dict, fetch_names, mesh=None,
+                          nan_check_meta=None):
+    """Microbatched step (PipelineOptimizer): the forward+backward ops run
+    under a lax.scan over ``M`` microbatch slices of every feed,
+    accumulating the parameter gradients; the optimize/lr ops then run ONCE
+    on the averaged grads. This is the reference PipelineTrainer /
+    SectionWorker schedule collapsed into one XLA program: the per-section
+    scope queues (trainer.h:110, device_worker.h:267 SectionWorker) become
+    the scan carry, and stage placement is GSPMD's job via sharding
+    annotations rather than per-section Places.
+
+    Fetches report the LAST microbatch's values (the reference fetches from
+    the final section's scope). Requires batch % M == 0.
+    """
+    import jax.numpy as jnp
+
+    from .framework import OpRole
+
+    program = block.program
+    M = int(getattr(program, "_pipeline_microbatches", 1))
+    pgs = list(getattr(program, "_pipeline_param_grads", []))
+    fb_ops = [op for op in block.ops
+              if op.attrs.get("__op_role__", OpRole.Forward)
+              in (OpRole.Forward, OpRole.Backward)]
+    tail_ops = [op for op in block.ops
+                if op.attrs.get("__op_role__", OpRole.Forward)
+                not in (OpRole.Forward, OpRole.Backward)]
+    grad_names = [g for _, g in pgs]
+    param_names = [p for p, _ in pgs]
+    # persistables the fwd/bwd section itself writes (e.g. BN stats) must
+    # thread through the scan carry
+    fb_written = {n for op in fb_ops for n in op.output_arg_names}
+    fb_state = [n for n in io["state_out"] if n in fb_written]
+
+    def step_fn(feed_vals, donated_vals, ro_vals, rng_key):
+        base: Dict[str, Any] = {}
+        base.update(zip(io["donated"], donated_vals))
+        base.update(zip(io["ro"], ro_vals))
+        feeds = []
+        for n, v in zip(io["feed_order"], feed_vals):
+            if v.shape[0] % M:
+                raise ValueError(
+                    f"pipeline: feed '{n}' batch {v.shape[0]} not divisible"
+                    f" by num_microbatches={M}")
+            feeds.append(v.reshape((M, v.shape[0] // M) + v.shape[1:]))
+        keys = jax.random.split(rng_key, M)
+
+        checks = None if nan_check_meta is None else []
+        grads0 = [jnp.zeros(base[p].shape, base[p].dtype)
+                  for p in param_names]
+        carry0 = (grads0, {n: base[n] for n in fb_state})
+
+        def micro(carry, xs):
+            acc, st = carry
+            key, slices = xs[0], xs[1:]
+            env = dict(base)
+            env.update(st)
+            env.update(zip(io["feed_order"], slices))
+            ctx = LowerCtx(base_key=key, mesh=mesh, program=program,
+                           nan_checks=None)
+            for op in fb_ops:
+                lower_op(op, env, ctx)
+            new_acc = [a + env[g] for a, g in zip(acc, grad_names)]
+            new_st = {n: env[n] for n in fb_state}
+            # only fb-PRODUCED fetches come from the scan; anything else
+            # (params, lr) must read the post-tail env or it would fetch
+            # stale pre-update values
+            outs = {n: env[n] for n in fetch_names if n in fb_written}
+            return (new_acc, new_st), outs
+
+        (acc, st), fetched = jax.lax.scan(
+            micro, carry0, (keys,) + tuple(feeds))
+        env = dict(base)
+        env.update(st)
+        for g, a in zip(grad_names, acc):
+            env[g] = a / M
+        if checks is not None:
+            # fb ops run inside the scan (their tracers can't escape), so
+            # the fwd/bwd sanitizer coverage is the accumulated grads and
+            # carried state checked here, plus per-op checks on tail ops
+            for g, a in zip(grad_names, acc):
+                checks.append((f"accumulated gradient '{g}' "
+                               f"(fwd/bwd microbatch scan)",
+                               jnp.isfinite(a).all()))
+            for n, v in st.items():
+                checks.append((f"carried state '{n}' (microbatch scan)",
+                               jnp.isfinite(v).all()))
+        ctx = LowerCtx(base_key=rng_key, mesh=mesh, program=program,
+                       nan_checks=checks)
+        for op in tail_ops:
+            lower_op(op, env, ctx)
+        fetches = [fetched[n][-1] if n in fetched else env[n]
+                   for n in fetch_names]
+        new_state = [env[n] for n in io["state_out"]]
+        if checks is not None:
+            nan_check_meta.clear()
+            nan_check_meta.extend(label for label, _ in checks)
+            flags_vec = (jnp.stack([ok for _, ok in checks])
+                         if checks else jnp.ones((0,), bool))
+            return fetches, new_state, flags_vec
+        return fetches, new_state
+
+    return step_fn
+
+
+def pick_step_fn(program):
+    """make_step_fn, or the microbatched variant when the program was
+    prepared by PipelineOptimizer."""
+    if int(getattr(program, "_pipeline_microbatches", 1)) > 1:
+        return make_pipeline_step_fn
+    return make_step_fn
+
+
 class Executor:
     """Reference API (executor.py:380): run / close; plus train loop helpers."""
 
@@ -347,7 +460,8 @@ class Executor:
         block = program.global_block
         io = analyze_block_io(block, feed_names, fetch_names)
         meta = [] if flag("check_nan_inf") else None
-        step_fn = make_step_fn(block, io, fetch_names, nan_check_meta=meta)
+        step_fn = pick_step_fn(program)(block, io, fetch_names,
+                                        nan_check_meta=meta)
         jitted = jax.jit(step_fn, donate_argnums=(1,))
         step = _CompiledStep(jitted, io["feed_order"], io["donated"],
                              io["ro"], io["state_out"], tuple(fetch_names))

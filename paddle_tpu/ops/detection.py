@@ -240,14 +240,15 @@ def _yolo_box(ctx, ins, attrs):
     return {"Boxes": [boxes], "Scores": [scores]}
 
 
-def _nms_class(boxes, scores, iou_thresh, score_thresh, top_k, eta=1.0):
+def _nms_class(boxes, scores, iou_thresh, score_thresh, top_k, eta=1.0,
+               normalized=True):
     """Greedy NMS. ``eta`` < 1 shrinks the IoU threshold after each kept
     box (reference NMSFast adaptive_threshold: thresh *= eta while
     thresh > 0.5). Returns (keep_mask, order, sorted boxes/scores)."""
     order = jnp.argsort(-scores)
     sboxes = boxes[order]
     sscores = scores[order]
-    iou = _iou_matrix(sboxes, sboxes)
+    iou = _iou_matrix(sboxes, sboxes, normalized)
     n = boxes.shape[0]
     k = min(top_k, n) if top_k and top_k > 0 else n
 
@@ -295,7 +296,8 @@ def _multiclass_nms(ctx, ins, attrs):
                 continue
             keep, order, sb, ss = _nms_class(
                 bx, sc[c], attrs["nms_threshold"],
-                attrs["score_threshold"], attrs["nms_top_k"], eta)
+                attrs["score_threshold"], attrs["nms_top_k"], eta,
+                attrs.get("normalized", True))
             lbl = jnp.full((M,), float(c), bx.dtype)
             row = jnp.concatenate([lbl[:, None], ss[:, None], sb], axis=1)
             rows.append(jnp.where(keep[:, None], row, -1.0))
@@ -319,7 +321,11 @@ def _roi_align_one(feat, roi, spatial_scale, ph, pw, sampling_ratio):
     rh = jnp.maximum(y1 - y0, 1.0)
     bin_w = rw / pw
     bin_h = rh / ph
-    s = sampling_ratio if sampling_ratio > 0 else 2
+    # reference sampling_ratio<=0 adapts the grid to ceil(roi/pooled) PER
+    # ROI — a data-dependent shape XLA cannot express; the static fallback
+    # is a 4x4 grid (pass an explicit sampling_ratio for exact reference
+    # parity)
+    s = sampling_ratio if sampling_ratio > 0 else 4
     # sample points per bin: s x s grid
     iy = jnp.arange(ph).reshape(ph, 1, 1, 1)
     ix = jnp.arange(pw).reshape(1, pw, 1, 1)

@@ -28,7 +28,7 @@ __all__ = [
     "AdamaxOptimizer", "DecayedAdagradOptimizer", "AdadeltaOptimizer",
     "RMSPropOptimizer", "FtrlOptimizer", "LambOptimizer",
     "LarsMomentumOptimizer", "ExponentialMovingAverage", "ModelAverage",
-    "LookaheadOptimizer", "RecomputeOptimizer",
+    "LookaheadOptimizer", "RecomputeOptimizer", "PipelineOptimizer",
 ]
 
 
@@ -862,6 +862,39 @@ class LookaheadOptimizer:
             block.append_op("sum", inputs={"X": [p.name, gated2]},
                             outputs={"Out": p.name})
         return ops, pgs
+
+
+class PipelineOptimizer:
+    """Pipelined (microbatched) training — reference optimizer.py:2781
+    PipelineOptimizer, which cuts the program into device-placed sections
+    run by SectionWorker threads passing scopes through queues
+    (trainer.h:110 PipelineTrainer, device_worker.h:267).
+
+    TPU-native collapse: the section queues become one lax.scan over
+    num_microbatches slices of the batch — forward+backward per slice with
+    gradient accumulation, one optimizer step on the averaged grads
+    (executor.make_pipeline_step_fn). Stage PLACEMENT is not per-section
+    Places but GSPMD sharding: annotate stage params over a 'pp' mesh axis
+    and XLA pipelines the collectives. ``cut_list`` is accepted for API
+    parity; cut-based placement hints are a no-op under GSPMD."""
+
+    def __init__(self, optimizer, cut_list=None, num_microbatches=2,
+                 start_cpu_core_id=0):
+        self._optimizer = optimizer
+        self._cut_list = cut_list
+        self._num_microbatches = int(num_microbatches)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = self._optimizer.minimize(loss, startup_program,
+                                          parameter_list, no_grad_set)
+        program = loss.block.program
+        _, params_grads = result
+        program._pipeline_microbatches = self._num_microbatches
+        program._pipeline_param_grads = [(p.name, g.name)
+                                         for p, g in params_grads]
+        program._bump_version()
+        return result
 
 
 class RecomputeOptimizer:

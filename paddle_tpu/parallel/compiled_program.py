@@ -182,7 +182,7 @@ class CompiledProgram:
     def _compile(self, program: Program, feed_names: set, fetch_names, scope):
         """Same env-threading as Executor._compile, but jitted with shardings
         over the mesh: feeds split on 'dp', state replicated."""
-        from ..executor import _CompiledStep, analyze_block_io, make_step_fn
+        from ..executor import _CompiledStep, analyze_block_io, pick_step_fn
 
         from ..flags import flag
 
@@ -190,8 +190,8 @@ class CompiledProgram:
         io = analyze_block_io(block, feed_names, fetch_names)
         mesh = self._mesh
         nan_meta = [] if flag("check_nan_inf") else None
-        step_fn = make_step_fn(block, io, fetch_names, mesh=mesh,
-                               nan_check_meta=nan_meta)
+        step_fn = pick_step_fn(program)(block, io, fetch_names, mesh=mesh,
+                                        nan_check_meta=nan_meta)
 
         batch_spec = NamedSharding(mesh, P("dp"))
         repl_spec = NamedSharding(mesh, P())

@@ -218,3 +218,28 @@ def test_metrics_accuracy_auc():
     labels = np.array([0, 1, 1, 0])
     auc.update(preds, labels)
     assert auc.eval() > 0.9
+
+
+def test_clone_for_test_after_minimize_prunes_grad_ops():
+    """Regression: generic grad ops must NOT inherit the forward op's
+    __op_role__ (they'd survive clone(for_test=True) and demand grad
+    feeds at inference)."""
+    import paddle_tpu.unique_name as un
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            out = fluid.layers.fc(x, 2)
+            loss = fluid.layers.mean(out)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+    infer = main.clone(for_test=True)
+    types = [op.type for op in infer.global_block.ops]
+    assert not any(t.endswith("_grad") or t == "sgd" for t in types), types
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (v,) = exe.run(infer, feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[out.name])
+    assert np.asarray(v).shape == (2, 2)

@@ -1,0 +1,107 @@
+"""PipelineOptimizer (microbatched training; reference optimizer.py:2781 +
+PipelineTrainer/SectionWorker): with a mean loss, M accumulated microbatch
+gradients average to the full-batch gradient, so training must match the
+plain path exactly."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.unique_name as un
+
+
+def _build(microbatches=None):
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[16], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(x, 32, act="relu")
+            pred = fluid.layers.fc(h, 1)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+            opt = fluid.optimizer.SGD(learning_rate=0.05)
+            if microbatches:
+                opt = fluid.optimizer.PipelineOptimizer(
+                    opt, num_microbatches=microbatches)
+            opt.minimize(loss)
+    return main, startup, loss
+
+
+def _train(microbatches=None, steps=8, batch=32, compiled=False):
+    main, startup, loss = _build(microbatches)
+    main.random_seed = 23
+    prog = main
+    if compiled:
+        prog = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(1)
+    xb = rng.randn(batch, 16).astype(np.float32)
+    yb = rng.randn(batch, 1).astype(np.float32)
+    out = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            (lv,) = exe.run(prog, feed={"x": xb, "y": yb},
+                            fetch_list=[loss.name])
+            out.append(float(np.asarray(lv).reshape(-1)[0]))
+    return out
+
+
+def test_pipeline_matches_plain_sgd():
+    """Param updates must be identical (mean loss => averaged microbatch
+    grads == full-batch grads); only the REPORTED loss differs (last
+    microbatch vs full batch), so compare from step 1 via param effects."""
+    base = _train(None)
+    pipe = _train(4)
+    # the training trajectory (loss after >=1 update) must track closely:
+    # identical params => pipe's step-k loss over its last microbatch equals
+    # base loss over that subset; check convergence + the end state via a
+    # fresh full-batch eval below instead of comparing mid-run numbers
+    assert pipe[-1] < pipe[0]
+    assert base[-1] < base[0]
+
+
+def test_pipeline_params_equal_plain():
+    """After N steps the parameters are bit-comparable to the plain path."""
+    def run(micro):
+        main, startup, loss = _build(micro)
+        main.random_seed = 23
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(1)
+        xb = rng.randn(32, 16).astype(np.float32)
+        yb = rng.randn(32, 1).astype(np.float32)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(6):
+                exe.run(main, feed={"x": xb, "y": yb},
+                        fetch_list=[loss.name])
+            params = {n: np.asarray(v) for n, v in scope.vars.items()
+                      if n.endswith(".w_0") or n.endswith(".b_0")}
+        return params
+
+    p_plain = run(None)
+    p_pipe = run(4)
+    assert p_plain.keys() == p_pipe.keys() and len(p_plain) >= 4
+    for n in p_plain:
+        np.testing.assert_allclose(p_pipe[n], p_plain[n], rtol=2e-5,
+                                   atol=1e-6, err_msg=n)
+
+
+def test_pipeline_under_data_parallel_mesh():
+    losses = _train(2, compiled=True)
+    assert losses[-1] < losses[0]
+
+
+def test_pipeline_batch_divisibility_error():
+    main, startup, loss = _build(3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(Exception, match="divisible"):
+            exe.run(main, feed={"x": rng.randn(32, 16).astype(np.float32),
+                                "y": rng.randn(32, 1).astype(np.float32)},
+                    fetch_list=[loss.name])
