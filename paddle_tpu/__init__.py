@@ -27,6 +27,7 @@ from . import inference
 from . import io
 from . import reader
 from .data_feeder import DataFeeder
+from .dataset_feed import DatasetFactory
 from .reader import DataLoader, PyReader, batch
 from . import metrics
 from . import optimizer

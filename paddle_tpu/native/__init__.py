@@ -1,0 +1,71 @@
+"""Native (C++) runtime components, built on demand with the system g++.
+
+Reference: the framework's native ingest path —
+paddle/fluid/framework/data_feed.cc MultiSlotDataFeed +
+operators/reader/lod_tensor_blocking_queue.h — is C++ so parsing never
+holds the GIL. Same here: datafeed.cpp compiles once into a cached shared
+object; if no compiler is available the callers fall back to the Python
+readers (degraded but functional).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_lib = None
+_build_error: Optional[str] = None
+
+
+def _build_dir() -> str:
+    d = os.environ.get("PADDLE_TPU_NATIVE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu", "native")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load_datafeed() -> Optional[ctypes.CDLL]:
+    """Compile-and-load (cached by source hash). None if no toolchain."""
+    global _lib, _build_error
+    if _lib is not None:
+        return _lib
+    if _build_error is not None:
+        return None
+    src = os.path.join(_HERE, "datafeed.cpp")
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_build_dir(), f"datafeed_{tag}.so")
+    if not os.path.exists(so):
+        tmp = so + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               src, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.replace(tmp, so)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            _build_error = getattr(e, "stderr", str(e)) or str(e)
+            return None
+    lib = ctypes.CDLL(so)
+    lib.df_create.restype = ctypes.c_void_p
+    lib.df_create.argtypes = [ctypes.c_char_p]
+    lib.df_set_capacity.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.df_add_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.df_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.df_start.restype = ctypes.c_int
+    lib.df_next.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                            ctypes.POINTER(ctypes.c_void_p),
+                            ctypes.POINTER(ctypes.c_void_p)]
+    lib.df_next.restype = ctypes.c_int
+    lib.df_parse_errors.argtypes = [ctypes.c_void_p]
+    lib.df_parse_errors.restype = ctypes.c_longlong
+    lib.df_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def build_error() -> Optional[str]:
+    return _build_error

@@ -21,6 +21,8 @@ __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
 
 _trace_dir = None
 _host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+_host_spans = []  # (name, t0_s, t1_s, small_tid) while profiling
+_tid_map = {}     # thread ident -> stable small timeline row id
 
 
 def start_profiler(state="All", tracer_option=None, profile_path="/tmp/profile"):
@@ -32,12 +34,23 @@ def start_profiler(state="All", tracer_option=None, profile_path="/tmp/profile")
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     global _trace_dir
     jax.profiler.stop_trace()
-    _trace_dir = None
     _print_host_report(sorted_key)
+    # span dump consumed by tools/timeline.py (the reference writes
+    # profiler.proto consumed by its timeline.py; here it is JSON)
+    if _trace_dir:
+        import json
+        import os
+
+        with open(os.path.join(_trace_dir, "host_events.json"), "w") as f:
+            json.dump([{"name": n, "t0": a, "t1": b, "tid": t}
+                       for n, a, b, t in _host_spans], f)
+    _trace_dir = None
+    _host_spans.clear()
 
 
 def reset_profiler():
     _host_events.clear()
+    _host_spans.clear()
 
 
 @contextlib.contextmanager
@@ -65,9 +78,16 @@ class RecordEvent:
 
     def __exit__(self, *exc):
         self._ann.__exit__(*exc)
+        t1 = time.perf_counter()
         rec = _host_events[self.name]
         rec[0] += 1
-        rec[1] += time.perf_counter() - self._t0
+        rec[1] += t1 - self._t0
+        if _trace_dir is not None:
+            import threading
+
+            ident = threading.get_ident()
+            tid = _tid_map.setdefault(ident, len(_tid_map))
+            _host_spans.append((self.name, self._t0, t1, tid))
         return False
 
 

@@ -243,3 +243,34 @@ def test_clone_for_test_after_minimize_prunes_grad_ops():
         (v,) = exe.run(infer, feed={"x": np.ones((2, 4), np.float32)},
                        fetch_list=[out.name])
     assert np.asarray(v).shape == (2, 2)
+
+
+def test_profiler_timeline_roundtrip(tmp_path):
+    """profiler span dump -> tools/timeline.py -> chrome trace JSON
+    (reference tools/timeline.py contract)."""
+    import json
+    import subprocess
+    import sys
+
+    from paddle_tpu import profiler as prof
+
+    d = str(tmp_path / "prof")
+    import os
+    os.makedirs(d)
+    prof.reset_profiler()
+    with prof.profiler(profile_path=d):
+        with prof.RecordEvent("step"):
+            with prof.RecordEvent("forward"):
+                np.ones((64, 64)) @ np.ones((64, 64))
+    out = str(tmp_path / "tl.json")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, os.path.join(repo, "tools",
+                                                     "timeline.py"),
+                        "--profile_path", d, "--timeline_path", out],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    tl = json.load(open(out))
+    names = {e["name"] for e in tl["traceEvents"]}
+    assert {"step", "forward"} <= names
+    for e in tl["traceEvents"]:
+        assert e["ph"] == "X" and e["dur"] >= 0

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Convert a profiler dump to Chrome tracing JSON (chrome://tracing /
+Perfetto).
+
+Reference: tools/timeline.py:21-25 — there the input is the C++ profiler's
+profiler.proto; here it is the host_events.json span dump that
+``fluid.profiler.profiler(profile_path=...)`` writes next to the XPlane
+trace (the XPlane dump itself opens directly in TensorBoard/Perfetto; this
+tool covers the host-side RecordEvent timeline).
+
+Usage:
+    python tools/timeline.py --profile_path /tmp/profile \
+                             --timeline_path /tmp/timeline.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def convert(profile_path: str, timeline_path: str) -> int:
+    src = profile_path
+    if os.path.isdir(src):
+        src = os.path.join(src, "host_events.json")
+    if not os.path.exists(src):
+        print(f"no host_events.json under {profile_path} — run under "
+              f"fluid.profiler.profiler(profile_path=...)", file=sys.stderr)
+        return 1
+    with open(src) as f:
+        spans = json.load(f)
+    if spans:
+        base = min(s["t0"] for s in spans)
+    events = [{
+        "name": s["name"],
+        "ph": "X",
+        "ts": (s["t0"] - base) * 1e6,   # microseconds, chrome convention
+        "dur": (s["t1"] - s["t0"]) * 1e6,
+        "pid": 0,
+        "tid": s.get("tid", 0),
+        "cat": "host",
+    } for s in spans]
+    with open(timeline_path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    print(f"wrote {len(events)} events to {timeline_path}")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile_path", required=True)
+    ap.add_argument("--timeline_path", required=True)
+    args = ap.parse_args(argv)
+    return convert(args.profile_path, args.timeline_path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
