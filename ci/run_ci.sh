@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# CI driver (the paddle_build.sh role, reference paddle/scripts/paddle_build.sh):
+#   ci/run_ci.sh [fast|full|tpu]
+#
+# fast: import check + CPU unit tests (8 virtual devices, what the repo's
+#       conftest configures)
+# full: fast + the multichip dry-run the round driver executes
+# tpu : the on-accelerator smoke suite (needs a real chip)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+MODE="${1:-fast}"
+
+echo "== import check"
+JAX_PLATFORMS=cpu python -c "
+import paddle_tpu
+print('ops registered:', len(paddle_tpu.op_registry.all_ops()))
+print('version:', paddle_tpu.__version__)"
+
+echo "== unit tests (CPU, 8 virtual devices)"
+python -m pytest tests/ -q -x
+
+if [ "$MODE" = "full" ]; then
+  echo "== multichip dry-run (8 virtual devices)"
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -c "import sys; sys.path.insert(0, '.'); \
+               import __graft_entry__ as g; g.dryrun_multichip(8)"
+fi
+
+if [ "$MODE" = "tpu" ]; then
+  echo "== on-chip smoke suite"
+  PADDLE_TPU_TESTS=1 python -m pytest tests/test_tpu_smoke.py -m tpu -q
+fi
+
+echo "CI $MODE: OK"

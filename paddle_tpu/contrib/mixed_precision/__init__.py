@@ -1,3 +1,4 @@
 """Mixed precision (AMP) — reference: fluid/contrib/mixed_precision/."""
-from .decorator import OptimizerWithMixedPrecision, decorate  # noqa: F401
+from .decorator import (OptimizerWithMixedPrecision, decorate,  # noqa: F401
+                        decorate_program)
 from .fp16_lists import AutoMixedPrecisionLists  # noqa: F401

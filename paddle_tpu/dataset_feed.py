@@ -33,6 +33,7 @@ class MultiSlotDataset:
 
     # -- reference Dataset config surface --------------------------------
     def set_use_var(self, slots: Sequence[Tuple[str, str, int]]):
+        self._slots = []  # replace, not append (reference set_use_var)
         for name, dtype, length in slots:
             if dtype not in ("float32", "int64"):
                 raise ValueError(f"slot '{name}': dtype must be float32 or "
@@ -107,6 +108,7 @@ class MultiSlotDataset:
                 if rows < self._batch:
                     break
         finally:
+            lib.df_stop_join(h)  # race-free: producers joined before read
             self._parse_errors = int(lib.df_parse_errors(h))
             lib.df_destroy(h)
 

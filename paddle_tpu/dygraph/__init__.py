@@ -9,3 +9,4 @@ from .nn import (FC, BatchNorm, Conv2D, Dropout, Embedding,  # noqa: F401
                  LayerNorm, Linear, Pool2D)
 from . import nn  # noqa: F401
 from . import ops  # noqa: F401
+from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401

@@ -184,3 +184,101 @@ class EditDistance(MetricBase):
             raise ValueError("EditDistance: no updates yet")
         return (self.total_distance / self.seq_num,
                 self.instance_error / self.seq_num)
+
+
+class DetectionMAP(MetricBase):
+    """Mean average precision for detection (reference metrics.py:695
+    DetectionMAP; math follows the detection_map op's '11point'/'integral'
+    modes). Host-side accumulation: update() takes per-image detections
+    [[label, score, x0, y0, x1, y1], ...] and ground truths
+    [[label, x0, y0, x1, y1], ...] (difficult GTs may append a 7th/6th
+    flag column)."""
+
+    def __init__(self, name=None, overlap_threshold=0.5,
+                 evaluate_difficult=False, ap_version="11point",
+                 class_num=None):
+        super().__init__(name)
+        self.overlap_threshold = overlap_threshold
+        self.evaluate_difficult = evaluate_difficult
+        if ap_version not in ("11point", "integral"):
+            raise ValueError("ap_version must be '11point' or 'integral'")
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._dets = []   # (img_id, label, score, box)
+        self._gts = []    # (img_id, label, box, difficult)
+        self._img = 0
+
+    @staticmethod
+    def _iou(a, b):
+        ix0, iy0 = max(a[0], b[0]), max(a[1], b[1])
+        ix1, iy1 = min(a[2], b[2]), min(a[3], b[3])
+        inter = max(ix1 - ix0, 0) * max(iy1 - iy0, 0)
+        ua = ((a[2] - a[0]) * (a[3] - a[1]) +
+              (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, detections, gts):
+        img = self._img
+        self._img += 1
+        for d in np.asarray(detections, np.float64).reshape(-1, 6):
+            if d[0] < 0:
+                continue  # -1 padding rows from multiclass_nms
+            self._dets.append((img, int(d[0]), float(d[1]), d[2:6]))
+        for g in np.asarray(gts, np.float64):
+            diff = bool(g[5]) if len(g) > 5 else False
+            self._gts.append((img, int(g[0]), g[1:5], diff))
+
+    def eval(self):
+        labels = sorted({l for _, l, _, _ in self._gts})
+        if not labels:
+            raise ValueError("DetectionMAP: no ground truths")
+        aps = []
+        for cls in labels:
+            gts = [(i, b, d) for i, l, b, d in self._gts if l == cls]
+            n_pos = sum(1 for _, _, d in gts
+                        if self.evaluate_difficult or not d)
+            dets = sorted((d for d in self._dets if d[1] == cls),
+                          key=lambda d: -d[2])
+            matched = set()
+            tp, fp = [], []
+            for img, _, score, box in dets:
+                cand = [(k, self._iou(box, b))
+                        for k, (gi, b, _) in enumerate(gts) if gi == img]
+                k_best, iou_best = max(cand, key=lambda kv: kv[1],
+                                       default=(None, 0.0))
+                if k_best is not None and iou_best >= self.overlap_threshold:
+                    _, _, difficult = gts[k_best]
+                    if difficult and not self.evaluate_difficult:
+                        continue  # difficult GT: detection neither tp nor fp
+                    if k_best in matched:
+                        fp.append(1); tp.append(0)
+                    else:
+                        matched.add(k_best)
+                        tp.append(1); fp.append(0)
+                else:
+                    fp.append(1); tp.append(0)
+            if n_pos == 0:
+                continue
+            tp = np.cumsum(tp, dtype=np.float64)
+            fp = np.cumsum(fp, dtype=np.float64)
+            rec = tp / n_pos
+            prec = tp / np.maximum(tp + fp, 1e-12)
+            if self.ap_version == "11point":
+                ap = 0.0
+                for t in np.linspace(0, 1, 11):
+                    p = prec[rec >= t].max() if (rec >= t).any() else 0.0
+                    ap += p / 11.0
+            else:  # integral / VOC2010-style
+                mrec = np.concatenate([[0.0], rec, [1.0]])
+                mpre = np.concatenate([[0.0], prec, [0.0]])
+                for i in range(len(mpre) - 2, -1, -1):
+                    mpre[i] = max(mpre[i], mpre[i + 1])
+                idx = np.where(mrec[1:] != mrec[:-1])[0]
+                ap = float(((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]).sum())
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0
+
+
+__all__.append("DetectionMAP")

@@ -62,6 +62,7 @@ def load_datafeed() -> Optional[ctypes.CDLL]:
     lib.df_next.restype = ctypes.c_int
     lib.df_parse_errors.argtypes = [ctypes.c_void_p]
     lib.df_parse_errors.restype = ctypes.c_longlong
+    lib.df_stop_join.argtypes = [ctypes.c_void_p]
     lib.df_destroy.argtypes = [ctypes.c_void_p]
     _lib = lib
     return _lib

@@ -11,9 +11,12 @@
 //
 // C ABI (ctypes-friendly, no pybind11 in this environment):
 //   df_create(spec)      spec = "name:f|i:len,..." fixed-length slots
-//   df_set_files(h, paths, n)
+//   df_set_capacity(h, cap)
+//   df_add_file(h, path)
 //   df_start(h, nthreads)
 //   df_next(h, batch, float** fbufs, long long** ibufs) -> rows filled
+//   df_parse_errors(h)   (call after df_stop_join for a final count)
+//   df_stop_join(h)      stop + join producers, handle stays valid
 //   df_destroy(h)
 #include <atomic>
 #include <condition_variable>
@@ -105,7 +108,9 @@ struct Feed {
       std::ifstream in(files[fi]);
       std::string line;
       while (!stop && std::getline(in, line)) {
-        if (line.empty()) continue;
+        // blank/whitespace-only lines are skipped, not errors (matches the
+        // Python fallback's `if not toks: continue`)
+        if (line.find_first_not_of(" \t\r") == std::string::npos) continue;
         Instance inst;
         if (!parse_line(line, &inst)) {
           parse_errors++;
@@ -218,6 +223,19 @@ int df_next(void* h, int batch, float** fbufs, int64_t** ibufs) {
 
 long long df_parse_errors(void* h) {
   return (long long)static_cast<Feed*>(h)->parse_errors.load();
+}
+
+// Stop producers and join them WITHOUT freeing the handle, so counters can
+// be read race-free before df_destroy.
+void df_stop_join(void* h) {
+  auto* f = static_cast<Feed*>(h);
+  f->stop = true;
+  {
+    std::lock_guard<std::mutex> lk(f->mu);
+    f->cv_push.notify_all();
+    f->cv_pop.notify_all();
+  }
+  f->join();
 }
 
 void df_destroy(void* h) { delete static_cast<Feed*>(h); }

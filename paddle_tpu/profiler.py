@@ -23,6 +23,9 @@ _trace_dir = None
 _host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
 _host_spans = []  # (name, t0_s, t1_s, small_tid) while profiling
 _tid_map = {}     # thread ident -> stable small timeline row id
+import threading as _threading  # noqa: E402
+
+_tid_lock = _threading.Lock()
 
 
 def start_profiler(state="All", tracer_option=None, profile_path="/tmp/profile"):
@@ -86,7 +89,8 @@ class RecordEvent:
             import threading
 
             ident = threading.get_ident()
-            tid = _tid_map.setdefault(ident, len(_tid_map))
+            with _tid_lock:
+                tid = _tid_map.setdefault(ident, len(_tid_map))
             _host_spans.append((self.name, self._t0, t1, tid))
         return False
 

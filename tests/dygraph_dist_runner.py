@@ -1,0 +1,59 @@
+"""Eager (dygraph) DataParallel runner for the launcher test (reference
+TestParallelDyGraphRunnerBase, test_dist_base.py:333): each rank trains on
+its slice of the SAME global batch; grads are averaged collectively, so
+losses... params must match the single-process full-batch run."""
+import json
+import os
+import sys
+
+import numpy as np
+
+GLOBAL_BATCH, STEPS, DIM = 8, 6, 12
+
+
+def main():
+    nranks = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+    if nranks > 1:
+        from paddle_tpu import distributed as dist
+
+        dist.init_parallel_env()
+    else:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as fluid
+    from paddle_tpu import dygraph
+
+    rng = np.random.RandomState(42)
+    w_true = np.linspace(-1, 1, DIM).astype(np.float32).reshape(DIM, 1)
+    xb = rng.rand(GLOBAL_BATCH, DIM).astype(np.float32)
+    yb = (xb @ w_true).astype(np.float32)
+    sl = slice(rank * (GLOBAL_BATCH // nranks),
+               (rank + 1) * (GLOBAL_BATCH // nranks)) if nranks > 1 \
+        else slice(None)
+
+    with dygraph.guard():
+        dygraph.seed_parameters(7)
+        model = dygraph.DataParallel(dygraph.Linear(DIM, 1))
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        losses = []
+        x = dygraph.to_variable(xb[sl])
+        y = dygraph.to_variable(yb[sl])
+        for _ in range(STEPS):
+            pred = model(x)
+            loss = dygraph.ops.mean(dygraph.ops.square(pred - y))
+            loss.backward()
+            model.apply_collective_grads()
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+            losses.append(float(loss.numpy()))
+        w_final = model.state_dict()["weight"].ravel().tolist()
+    if rank == 0:
+        print("WFINAL " + json.dumps(w_final), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

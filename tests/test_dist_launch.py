@@ -94,6 +94,34 @@ def test_two_process_sharded_deepfm():
     assert base[-1] < base[0]
 
 
+def test_two_process_dygraph_data_parallel():
+    """Eager DataParallel: 2 ranks on half-batches with collective grad
+    averaging must land on the same params as 1 process on the full batch
+    (reference TestParallelDyGraphRunnerBase)."""
+    env = _clean_env()
+    runner = os.path.join(REPO, "tests", "dygraph_dist_runner.py")
+
+    def read_w(out):
+        for line in out.splitlines():
+            if line.startswith("WFINAL "):
+                return json.loads(line[len("WFINAL "):])
+        raise AssertionError(f"no WFINAL line:\n{out}")
+
+    single = subprocess.run([sys.executable, "-u", runner], env=env,
+                            capture_output=True, text=True, timeout=600)
+    assert single.returncode == 0, single.stdout + single.stderr
+    base = read_w(single.stdout)
+
+    dist = subprocess.run(
+        [sys.executable, "-u", "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--backend", "cpu",
+         "--local_devices", "1", runner],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert dist.returncode == 0, dist.stdout + dist.stderr
+    got = read_w(dist.stdout)
+    np.testing.assert_allclose(got, base, rtol=2e-4, atol=1e-6)
+
+
 def test_launcher_propagates_failure():
     env = _clean_env()
     bad = os.path.join(REPO, "tests", "conftest.py")  # not a runnable trainer

@@ -218,3 +218,20 @@ def test_distributions():
                                rtol=1e-5)
     np.testing.assert_allclose(clp_.reshape(-1)[0], np.log(p[1]), rtol=1e-5)
     assert s_.shape == (4, 2) and np.isfinite(s_).all()
+
+
+def test_detection_map_metric():
+    from paddle_tpu.metrics import DetectionMAP
+
+    m = DetectionMAP(ap_version="11point")
+    # img0: one GT of class 0, detected perfectly + one FP
+    m.update([[0, 0.9, 0, 0, 10, 10], [0, 0.3, 50, 50, 60, 60]],
+             [[0, 0, 0, 10, 10]])
+    # img1: one GT of class 0, missed entirely
+    m.update([[-1, -1, -1, -1, -1, -1]], [[0, 20, 20, 30, 30]])
+    v = m.eval()
+    # recall caps at 0.5 -> 11-point AP = 6/11 * precision(1.0)
+    np.testing.assert_allclose(v, 6 / 11, rtol=1e-6)
+    m2 = DetectionMAP(ap_version="integral")
+    m2.update([[0, 0.9, 0, 0, 10, 10]], [[0, 0, 0, 10, 10]])
+    np.testing.assert_allclose(m2.eval(), 1.0, rtol=1e-6)
