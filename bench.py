@@ -156,34 +156,53 @@ def bench_bert_train(batch=32, seq_len=128, iters=10):
 
 
 def main():
-    train_bf16 = bench_resnet_train(amp=True)
-    train_fp32 = bench_resnet_train(amp=False)
-    infer_bf16_ms = bench_resnet_infer(amp=True)
-    infer_fp32_ms = bench_resnet_infer(amp=False)
-    bert_steps, bert_tflops = bench_bert_train()
+    """Sections run independently (a failure/timeout in one never loses the
+    others) and the JSON line always prints. Compiles through the axon dev
+    tunnel take ~2-3 min per section and the remote backend ignores the
+    local persistent cache, so the suite is kept to the three numbers that
+    matter: the headline training throughput, the only reference-comparable
+    inference figure, and BERT steps/s."""
+    extra = {}
 
-    train_tflops = train_bf16 * RESNET50_TRAIN_GFLOP_PER_IMG / 1e3
+    def section(key, fn):
+        t0 = time.time()
+        try:
+            val = fn()
+            extra[f"{key}_bench_seconds"] = round(time.time() - t0, 1)
+            return val
+        except Exception as e:  # record, keep going
+            extra[f"{key}_error"] = f"{type(e).__name__}: {e}"[:200]
+            return None
+
+    train_bf16 = section("resnet50_train_bf16",
+                         lambda: bench_resnet_train(amp=True))
+    infer_bf16_ms = section("resnet50_infer_bf16",
+                            lambda: bench_resnet_infer(amp=True))
+    bert = section("bert", bench_bert_train)
+
+    if train_bf16 is not None:
+        train_tflops = train_bf16 * RESNET50_TRAIN_GFLOP_PER_IMG / 1e3
+        extra["resnet50_train_bf16_tflops"] = round(train_tflops, 1)
+        extra["resnet50_train_mfu_vs_v5e_peak"] = round(
+            train_tflops / V5E_BF16_PEAK_TFLOPS, 3)
+    if infer_bf16_ms is not None:
+        extra["resnet50_infer_bs128_bf16_ms"] = round(infer_bf16_ms, 2)
+        extra["ref_v100_fp16_infer_bs128_ms"] = REF_FP16_INFER_MS
+    if bert is not None:
+        bert_steps, bert_tflops = bert
+        extra["bert_base_train_bf16_steps_per_s"] = round(bert_steps, 2)
+        extra["bert_base_train_bf16_tflops"] = round(bert_tflops, 1)
+        extra["bert_base_train_mfu_vs_v5e_peak"] = round(
+            bert_tflops / V5E_BF16_PEAK_TFLOPS, 3)
+        extra["bert_batch"], extra["bert_seq_len"] = 32, 128
+
     print(json.dumps({
         "metric": "resnet50_train_bf16_img_per_s",
-        "value": round(train_bf16, 1),
+        "value": round(train_bf16, 1) if train_bf16 is not None else -1,
         "unit": "img/s/chip",
-        "vs_baseline": round(REF_FP16_INFER_MS / infer_bf16_ms, 3),
-        "extra": {
-            "resnet50_train_fp32_img_per_s": round(train_fp32, 1),
-            "resnet50_train_bf16_speedup_vs_fp32":
-                round(train_bf16 / train_fp32, 2),
-            "resnet50_train_bf16_tflops": round(train_tflops, 1),
-            "resnet50_train_mfu_vs_v5e_peak":
-                round(train_tflops / V5E_BF16_PEAK_TFLOPS, 3),
-            "resnet50_infer_bs128_bf16_ms": round(infer_bf16_ms, 2),
-            "resnet50_infer_bs128_fp32_ms": round(infer_fp32_ms, 2),
-            "ref_v100_fp16_infer_bs128_ms": REF_FP16_INFER_MS,
-            "bert_base_train_bf16_steps_per_s": round(bert_steps, 2),
-            "bert_base_train_bf16_tflops": round(bert_tflops, 1),
-            "bert_base_train_mfu_vs_v5e_peak":
-                round(bert_tflops / V5E_BF16_PEAK_TFLOPS, 3),
-            "bert_batch": 32, "bert_seq_len": 128,
-        },
+        "vs_baseline": (round(REF_FP16_INFER_MS / infer_bf16_ms, 3)
+                        if infer_bf16_ms else -1),
+        "extra": extra,
     }))
 
 

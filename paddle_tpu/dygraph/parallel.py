@@ -40,20 +40,24 @@ class DataParallel(Layer):
         return loss
 
     def apply_collective_grads(self):
-        """Average every parameter gradient across ranks (reference
-        :201 coalesce + allreduce)."""
+        """Average every parameter gradient across ranks in ONE pytree
+        collective (the reference :201 coalesces grads before its
+        allreduce for the same reason: one launch, not N round-trips)."""
         import jax
 
         if jax.process_count() <= 1:
             return
         from jax.experimental import multihost_utils
 
-        for p in self._layers.parameters():
-            if p._grad is None:
-                continue
-            stacked = multihost_utils.process_allgather(
-                np.asarray(p._grad), tiled=False)
-            p._grad = jax.numpy.asarray(np.mean(np.asarray(stacked), axis=0))
+        with_grads = [p for p in self._layers.parameters()
+                      if p._grad is not None]
+        if not with_grads:
+            return
+        tree = {p.uid: np.asarray(p._grad) for p in with_grads}
+        gathered = multihost_utils.process_allgather(tree, tiled=False)
+        for p in with_grads:
+            p._grad = jax.numpy.asarray(
+                np.mean(np.asarray(gathered[p.uid]), axis=0))
 
     # -- delegation --------------------------------------------------------
     def parameters(self):

@@ -151,3 +151,85 @@ def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
                      attrs={"blank": int(blank),
                             "norm_by_times": norm_by_times})
     return loss
+
+
+class DynamicRNN:
+    """reference layers/control_flow.py DynamicRNN: step over a LoD input.
+
+    Padded-encoding mapping: the loop is StaticRNN (one lax.scan) over the
+    padded time axis; per-step outputs are re-masked by the sequence
+    lengths, so every VALID position equals the reference's packed
+    computation (invalid steps never feed back into valid ones — step t
+    only consumes memory from t-1). Usage mirrors the reference:
+
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            w = drnn.step_input(emb)         # [B, T, E] lod_level-1
+            prev = drnn.memory(shape=[H])
+            h = layers.fc(layers.concat([w, prev], 1), H, act="tanh")
+            drnn.update_memory(prev, h)
+            drnn.output(h)
+        hidden = drnn()                      # [B, T, H] + lengths companion
+    """
+
+    def __init__(self, name=None):
+        from . import control_flow as _cf
+
+        self._rnn = _cf.StaticRNN(name=name)
+        self._lod_source = None
+
+    def block(self):
+        return self._rnn.step()
+
+    def step_input(self, x, level=0):
+        from . import nn as _nn
+
+        if self._lod_source is None:
+            self._lod_source = x
+        # StaticRNN wants time-major; build the transpose OUTSIDE the block
+        program = x.block.program
+        cur = program.current_block_idx
+        program.current_block_idx = self._rnn._parent.idx
+        try:
+            tm = _nn.transpose(x, [1, 0] + list(range(2, len(x.shape))))
+        finally:
+            program.current_block_idx = cur
+        return self._rnn.step_input(tm)
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype="float32"):
+        if init is not None:
+            return self._rnn.memory(init=init)
+        if self._lod_source is None:
+            raise ValueError("call step_input before memory(shape=...) so "
+                             "the batch size is known (reference order)")
+        batch_ref = self._tm_of_source()
+        return self._rnn.memory(shape=shape, batch_ref=batch_ref,
+                                init_value=value, dtype=dtype)
+
+    def _tm_of_source(self):
+        # the first step input is time-major with the right batch dim
+        src_name = self._rnn._step_inputs[0][0]
+        return self._rnn._parent._var_recursive(src_name)
+
+    def update_memory(self, mem, new):
+        self._rnn.update_memory(mem, new)
+
+    def output(self, *outs):
+        self._rnn.output(*outs)
+
+    def __call__(self):
+        from . import nn as _nn
+        from .sequence import seq_len_var, sequence_unpad
+
+        outs_tm = self._rnn()
+        outs_tm = outs_tm if isinstance(outs_tm, list) else [outs_tm]
+        ln = seq_len_var(self._lod_source)
+        results = []
+        for o in outs_tm:
+            bm = _nn.transpose(o, [1, 0] + list(range(2, len(o.shape))))
+            results.append(sequence_unpad(bm, ln))  # mask + @LOD companion
+        return results[0] if len(results) == 1 else results
+
+
+__all__.append("DynamicRNN")

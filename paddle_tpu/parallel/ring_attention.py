@@ -1,0 +1,110 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+The reference (2019) has NO long-context story beyond LoD packing
+(SURVEY §5); this is the capability-parity-PLUS item the TPU rebuild adds:
+attention over sequences sharded across chips, K/V blocks rotating around
+the ICI ring (`jax.lax.ppermute` lowers to collective-permute on TPU; the
+same code runs on the CPU test mesh), with flash-style ONLINE softmax —
+running max + denominator — so no chip ever materialises the full
+[T, T] score matrix or the gathered K/V. Memory per chip is O(T_local),
+enabling sequences P times longer than single-chip attention.
+
+Layout: q/k/v are [batch, seq, heads, head_dim] sharded on `seq` over the
+ring axis. Causal masking uses GLOBAL positions reconstructed from the
+ring step, so results equal single-device causal attention exactly.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_attention_local", "attention_reference"]
+
+
+def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
+                         scale: Optional[float] = None):
+    """The per-shard body — call inside shard_map over ``axis_name``.
+
+    q, k, v: [B, T_local, H, D] local chunks. Returns [B, T_local, H, D].
+    """
+    B, Tl, H, D = q.shape
+    P_ = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    q = q * scale
+
+    neg = jnp.asarray(jnp.finfo(q.dtype).min, q.dtype)
+    # accumulators derive from q so they inherit its varying-axes type on
+    # ANY mesh (shard_map vma tracking: a fresh jnp.zeros would be
+    # unvaried and mismatch the scan carry after the ppermute)
+    zero_qh = q.sum(axis=-1) * 0.0                     # [B, Tl, H]
+    m0 = zero_qh + neg                                 # running max
+    l0 = zero_qh                                       # running denom
+    o0 = q * 0.0                                       # numerator acc
+    perm = [(i, (i + 1) % P_) for i in range(P_)]
+
+    q_pos = my * Tl + jnp.arange(Tl)                   # global q positions
+
+    def step(carry, s):
+        m, l, o, kb, vb = carry
+        src = (my - s) % P_                            # owner of this block
+        k_pos = src * Tl + jnp.arange(Tl)
+        # scores: [B, Tl(q), H, Tl(k)]
+        scores = jnp.einsum("bqhd,bkhd->bqhk", q, kb)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]    # [Tq, Tk] global
+            scores = jnp.where(mask[None, :, None, :], scores, neg)
+        blk_max = scores.max(axis=-1)                  # [B, Tq, H]
+        m_new = jnp.maximum(m, blk_max)
+        # guard fully-masked rows: exp(neg - neg) would be 1
+        alive = m_new > neg
+        corr = jnp.where(alive, jnp.exp(m - m_new), 0.0)
+        p = jnp.exp(scores - m_new[..., None])
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum("bqhk,bkhd->bqhd", p, vb)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (m_new, l_new, o_new, kb, vb), None
+
+    (m, l, o, _, _), _ = jax.lax.scan(step, (m0, l0, o0, k, v),
+                                      jnp.arange(P_))
+    return o / jnp.maximum(l, 1e-20)[..., None]
+
+
+def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "sp",
+                   causal: bool = False, scale: Optional[float] = None):
+    """shard_map wrapper: q/k/v [B, T, H, D] (global); T shards over
+    ``seq_axis``, batch over 'dp' when the mesh has one."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    batch_axis = "dp" if "dp" in mesh.axis_names else None
+    spec = P(batch_axis, seq_axis, None, None)
+
+    fn = shard_map(
+        partial(ring_attention_local, axis_name=seq_axis, causal=causal,
+                scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def attention_reference(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Dense single-device attention (the correctness oracle)."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bqhk", q * scale, k)
+    if causal:
+        T = q.shape[1]
+        mask = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        scores = jnp.where(mask[None, :, None, :], scores,
+                           jnp.finfo(q.dtype).min)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bqhk,bkhd->bqhd", p, v)

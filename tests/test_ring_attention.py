@@ -1,0 +1,102 @@
+"""Ring attention (sequence/context parallelism): sharded results must
+equal dense single-device attention exactly, causal and bidirectional,
+including on a combined dp x sp mesh and through jax.grad."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.parallel.ring_attention import (attention_reference,
+                                                ring_attention)
+from paddle_tpu.parallel.sharding import make_mesh
+
+B, T, H, D = 2, 32, 4, 8
+
+
+def _qkv(seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.randn(B, T, H, D).astype(np.float32) * 0.5
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_dense(causal):
+    q, k, v = _qkv()
+    mesh = make_mesh({"sp": 8})
+    got = ring_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                         mesh, causal=causal)
+    want = attention_reference(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ring_on_dp_sp_mesh():
+    q, k, v = _qkv(1)
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    got = ring_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                         mesh, causal=True)
+    want = attention_reference(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ring_attention_differentiable():
+    q, k, v = _qkv(2)
+    mesh = make_mesh({"sp": 4})
+
+    def loss_ring(q_, k_, v_):
+        return ring_attention(q_, k_, v_, mesh, causal=True).sum()
+
+    def loss_dense(q_, k_, v_):
+        return attention_reference(q_, k_, v_, causal=True).sum()
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                   rtol=5e-5, atol=5e-6)
+
+
+def test_ring_memory_is_local():
+    """The point of the ring: no [T, T] global score matrix and no
+    all-gathered K/V. Walk the whole jaxpr INCLUDING the shard_map and
+    scan sub-jaxprs and assert no intermediate carries a full-T dim in two
+    positions (scores) or a gathered [.., T, ..] K/V block."""
+    q, k, v = _qkv(3)
+    mesh = make_mesh({"sp": 8})
+    fn = lambda a, b, c: ring_attention(a, b, c, mesh, causal=False)
+    jaxpr = jax.make_jaxpr(fn)(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v))
+
+    seen = []
+
+    def walk(jx, inside_shard_map):
+        for eqn in jx.eqns:
+            for out in eqn.outvars:
+                shape = tuple(getattr(out.aval, "shape", ()))
+                seen.append(shape)
+                if inside_shard_map:
+                    # everything inside the manual region is per-chip: a
+                    # full-T array would mean gathered K/V or global scores
+                    assert T not in shape, \
+                        f"full-T intermediate {shape} in {eqn.primitive}"
+                else:
+                    assert shape.count(T) < 2, \
+                        f"global score matrix {shape} in {eqn.primitive}"
+            for val in eqn.params.values():
+                # sub-jaxprs appear as raw Jaxpr (has .eqns) or ClosedJaxpr
+                inner = val if hasattr(val, "eqns") else \
+                    getattr(val, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    walk(inner, inside_shard_map or
+                         "shard_map" in str(eqn.primitive))
+
+    walk(jaxpr.jaxpr, False)
+    # sanity: the walk actually visited the scan body's score matmuls
+    Tl = T // 8
+    assert any(s.count(Tl) >= 2 for s in seen), seen[:10]

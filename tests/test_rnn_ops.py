@@ -254,3 +254,65 @@ def test_cudnn_lstm_matches_stacked_reference():
         (got,) = exe.run(main, feed={"x": xv, "w": wflat, "lens": lens},
                          fetch_list=["o1"])
     np.testing.assert_allclose(got, seq, rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_rnn_varlen_training():
+    """DynamicRNN (reference control_flow.py) over genuinely variable-length
+    batches: trains, and the loss is invariant to the padding width."""
+    import paddle_tpu.unique_name as un
+
+    def build():
+        with un.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", shape=[6], dtype="float32",
+                                      lod_level=1)
+                y = fluid.layers.data("y", shape=[1], dtype="float32")
+                drnn = fluid.layers.DynamicRNN()
+                with drnn.block():
+                    w = drnn.step_input(x)
+                    prev = drnn.memory(shape=[8])
+                    h = fluid.layers.fc(
+                        fluid.layers.concat([w, prev], axis=1), 8,
+                        act="tanh", name="cell",
+                        param_attr=fluid.ParamAttr(name="cell_w"),
+                        bias_attr=False)
+                    drnn.update_memory(prev, h)
+                    drnn.output(h)
+                hidden = drnn()                       # [B, T, 8] masked
+                last = fluid.layers.sequence_pool(hidden, "last")
+                pred = fluid.layers.fc(last, 1, name="out",
+                                       param_attr=fluid.ParamAttr(name="o_w"),
+                                       bias_attr=False)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.SGD(0.05).minimize(loss)
+        main.random_seed = 31
+        return main, startup, loss, (x, y)
+
+    rng = np.random.RandomState(4)
+    samples = []
+    for _ in range(8):
+        L = int(rng.randint(2, 7))
+        seq = rng.randn(L, 6).astype(np.float32)
+        samples.append((seq, np.array([seq.sum() * 0.1], np.float32)))
+
+    def run(buckets, steps):
+        main, startup, loss, feed_vars = build()
+        feeder = fluid.DataFeeder(feed_list=list(feed_vars), program=main,
+                                  seq_buckets=buckets)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        out = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(steps):
+                (lv,) = exe.run(main, feed=feeder.feed(samples),
+                                fetch_list=[loss.name])
+                out.append(float(np.asarray(lv).reshape(-1)[0]))
+        return out
+
+    a = run((8,), 10)
+    b = run((16,), 10)
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)  # pad-invariant
+    assert a[-1] < a[0] * 0.8
