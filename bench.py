@@ -6,9 +6,9 @@ chip"). The reference publishes no training numbers (BASELINE.md), so
 ``vs_baseline`` compares our bf16 INFERENCE latency against the reference's
 published ResNet50 bs=128 fp16 number (64.52 ms on 1x V100,
 paddle/contrib/float16/float16_benchmark.md:41-45) — the only mixed-precision
-apples-to-apples figure that exists. The ``extra`` dict carries the full
-suite: fp32/bf16 train+infer, BERT-base steps/s, achieved TFLOP/s and an MFU
-estimate vs a v5e bf16 peak.
+apples-to-apples figure that exists. ``extra`` carries bf16 inference ms,
+BERT-base steps/s, achieved TFLOP/s + MFU vs v5e bf16 peak, and per-section
+wall times (or ``<key>_error`` strings for sections that raised).
 
 Feeds are staged on device once: measures compute, not the dev-tunnel's
 host->device bandwidth (the DataLoader's double-buffer prefetch overlaps that
@@ -156,12 +156,13 @@ def bench_bert_train(batch=32, seq_len=128, iters=10):
 
 
 def main():
-    """Sections run independently (a failure/timeout in one never loses the
-    others) and the JSON line always prints. Compiles through the axon dev
-    tunnel take ~2-3 min per section and the remote backend ignores the
-    local persistent cache, so the suite is kept to the three numbers that
-    matter: the headline training throughput, the only reference-comparable
-    inference figure, and BERT steps/s."""
+    """Sections run independently: one that RAISES never loses the others
+    and the JSON line still prints (a section that hangs is still fatal —
+    only the external driver's timeout can reap that). Compiles through the
+    axon dev tunnel take ~2-3 min per section and the remote backend
+    ignores the local persistent cache, so the suite is kept to the three
+    numbers that matter: the headline training throughput, the only
+    reference-comparable inference figure, and BERT steps/s."""
     extra = {}
 
     def section(key, fn):

@@ -1,2 +1,3 @@
 """fluid.contrib namespace (reference: python/paddle/fluid/contrib/)."""
 from . import mixed_precision  # noqa: F401
+from . import slim  # noqa: F401

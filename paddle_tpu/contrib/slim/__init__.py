@@ -1,0 +1,2 @@
+"""Model compression (reference python/paddle/fluid/contrib/slim/)."""
+from . import quantization  # noqa: F401

@@ -1,0 +1,1 @@
+from .quantization_pass import QuantizationTransformPass, quant_aware  # noqa: F401

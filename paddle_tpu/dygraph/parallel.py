@@ -53,11 +53,15 @@ class DataParallel(Layer):
                       if p._grad is not None]
         if not with_grads:
             return
-        tree = {p.uid: np.asarray(p._grad) for p in with_grads}
+        # keyed by POSITION in parameters() order — deterministic across
+        # ranks; uids are process-local counters and can drift if any rank
+        # created extra eager tensors
+        tree = {str(i): np.asarray(p._grad)
+                for i, p in enumerate(with_grads)}
         gathered = multihost_utils.process_allgather(tree, tiled=False)
-        for p in with_grads:
+        for i, p in enumerate(with_grads):
             p._grad = jax.numpy.asarray(
-                np.mean(np.asarray(gathered[p.uid]), axis=0))
+                np.mean(np.asarray(gathered[str(i)]), axis=0))
 
     # -- delegation --------------------------------------------------------
     def parameters(self):

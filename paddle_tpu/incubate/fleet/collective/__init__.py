@@ -24,7 +24,8 @@ from ....parallel.compiled_program import (BuildStrategy, CompiledProgram,
                                            ReduceStrategy)
 from ..base.role_maker import PaddleCloudRoleMaker, RoleMakerBase
 
-__all__ = ["fleet", "Fleet", "DistributedStrategy", "CollectiveOptimizer"]
+__all__ = ["fleet", "Fleet", "DistributedStrategy", "CollectiveOptimizer",
+           "LocalSGDSync"]
 
 
 class DistributedStrategy:
@@ -157,6 +158,48 @@ class CollectiveOptimizer:
         self._fleet._compiled = CompiledProgram(program).with_data_parallel(
             loss_name=loss.name, build_strategy=bs)
         return result
+
+
+class LocalSGDSync:
+    """LocalSGD (reference transpiler/collective.py:269 LocalSGD): each
+    rank trains INDEPENDENTLY (no per-step gradient allreduce) and every
+    ``k`` steps the persistable parameters are averaged across processes —
+    trading per-step ICI/DCN traffic for slightly stale weights.
+
+    Usage: run the PLAIN (non-data-parallel) program per rank and call
+    ``sync.step(scope)`` after each exe.run; every k-th call averages.
+    """
+
+    def __init__(self, program, k_steps: int = 1):
+        self._names = [p.name for p in program.all_parameters()]
+        self._k = max(1, int(k_steps))
+        self._count = 0
+
+    def step(self, scope) -> bool:
+        """Returns True when a sync happened on this call."""
+        self._count += 1
+        if self._count % self._k:
+            return False
+        import jax
+
+        if jax.process_count() <= 1:
+            return True  # single process: averaging is the identity
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        tree = {}
+        for n in self._names:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"LocalSGDSync: parameter '{n}' not initialized in "
+                    f"scope — run the startup program first")
+            tree[n] = np.asarray(v)
+        gathered = multihost_utils.process_allgather(tree, tiled=False)
+        for n in self._names:
+            scope.set_var(n, jax.numpy.asarray(
+                np.mean(np.asarray(gathered[n]), axis=0)))
+        return True
 
 
 fleet = Fleet()

@@ -1,0 +1,55 @@
+"""Fake-quantization ops for quantization-aware training.
+
+Reference: paddle/fluid/operators/fake_quantize_op.{cc,h} —
+FakeQuantizeAbsMax / FakeQuantizeDequantizeMovingAverageAbsMax, inserted by
+the slim QuantizationTransformPass. Forward simulates int8 rounding;
+backward is the straight-through estimator (grad passes unchanged), which
+here falls out of writing the output as x + stop_gradient(quant(x) - x) —
+no custom grad registration needed under jax.vjp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .common import IOSpec, out, register_op, x
+
+
+def _fake_quant(v, scale, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(v / s, -1.0, 1.0) * qmax) / qmax * s
+    # straight-through estimator: identity gradient, quantized value
+    return v + jax.lax.stop_gradient(q - v)
+
+
+@register_op("fake_quantize_dequantize_abs_max",
+             inputs=[IOSpec("X")],
+             outputs=["Out", IOSpec("OutScale", no_grad=True)],
+             attrs={"bit_length": 8})
+def _fake_quant_abs_max(ctx, ins, attrs):
+    """Per-tensor abs-max scale computed in-graph (reference
+    fake_quantize_op.h FindAbsMaxFunctor + ClipAndFakeQuantFunctor)."""
+    v = x(ins)
+    scale = jnp.max(jnp.abs(v))
+    return {"Out": [_fake_quant(v, scale, attrs["bit_length"])],
+            "OutScale": [scale.reshape((1,))]}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             inputs=[IOSpec("X"), IOSpec("InScale", no_grad=True)],
+             outputs=["Out", IOSpec("OutScale", no_grad=True)],
+             attrs={"bit_length": 8, "moving_rate": 0.9, "is_test": False})
+def _fake_quant_moving_avg(ctx, ins, attrs):
+    """Activation quantization: the scale is an exponential moving average
+    of batch abs-maxes held in a persistable var (reference
+    FakeQuantizeDequantizeMovingAverageAbsMaxOp state)."""
+    v, in_scale = x(ins, "X"), x(ins, "InScale")
+    rate = attrs["moving_rate"]
+    cur = jnp.max(jnp.abs(v))
+    if attrs.get("is_test"):
+        scale = in_scale.reshape(())
+    else:
+        scale = rate * in_scale.reshape(()) + (1 - rate) * cur
+    return {"Out": [_fake_quant(v, scale, attrs["bit_length"])],
+            "OutScale": [scale.reshape((1,))]}

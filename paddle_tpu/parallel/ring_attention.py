@@ -49,30 +49,39 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
 
     q_pos = my * Tl + jnp.arange(Tl)                   # global q positions
 
-    def step(carry, s):
-        m, l, o, kb, vb = carry
+    def block_update(m, l, o, kb, vb, s):
         src = (my - s) % P_                            # owner of this block
         k_pos = src * Tl + jnp.arange(Tl)
         # scores: [B, Tl(q), H, Tl(k)]
         scores = jnp.einsum("bqhd,bkhd->bqhk", q, kb)
+        valid = jnp.ones((Tl, Tl), bool)
         if causal:
-            mask = q_pos[:, None] >= k_pos[None, :]    # [Tq, Tk] global
-            scores = jnp.where(mask[None, :, None, :], scores, neg)
+            valid = q_pos[:, None] >= k_pos[None, :]   # [Tq, Tk] global
+            scores = jnp.where(valid[None, :, None, :], scores, neg)
         blk_max = scores.max(axis=-1)                  # [B, Tq, H]
         m_new = jnp.maximum(m, blk_max)
-        # guard fully-masked rows: exp(neg - neg) would be 1
+        # fully-masked rows keep m == neg; their corr/p must be 0 or
+        # exp(neg - neg)=1 would average masked-out values in
         alive = m_new > neg
         corr = jnp.where(alive, jnp.exp(m - m_new), 0.0)
         p = jnp.exp(scores - m_new[..., None])
-        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        p = p * (valid[None, :, None, :] & alive[..., None])
         l_new = l * corr + p.sum(axis=-1)
         o_new = o * corr[..., None] + jnp.einsum("bqhk,bkhd->bqhd", p, vb)
+        return m_new, l_new, o_new
+
+    def step(carry, s):
+        m, l, o, kb, vb = carry
+        m, l, o = block_update(m, l, o, kb, vb, s)
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
-        return (m_new, l_new, o_new, kb, vb), None
+        return (m, l, o, kb, vb), None
 
-    (m, l, o, _, _), _ = jax.lax.scan(step, (m0, l0, o0, k, v),
-                                      jnp.arange(P_))
+    # scan P-1 rotating steps, then peel the LAST block without the two
+    # dead trailing ppermutes (the rotated K/V would be discarded)
+    (m, l, o, kb, vb), _ = jax.lax.scan(step, (m0, l0, o0, k, v),
+                                        jnp.arange(P_ - 1))
+    m, l, o = block_update(m, l, o, kb, vb, P_ - 1)
     return o / jnp.maximum(l, 1e-20)[..., None]
 
 

@@ -69,12 +69,31 @@ def main():
 
     local = GLOBAL_BATCH // nranks
     losses = []
-    for step in range(STEPS):
-        sl = slice(rank * local, (rank + 1) * local) if nranks > 1 \
-            else slice(None)
-        lv = exe.run(compiled, feed={k: v[sl] for k, v in feeds.items()},
-                     fetch_list=[loss])[0]
-        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    if os.getenv("DIST_LOCALSGD"):
+        # LocalSGD: plain per-rank program, parameter averaging every k
+        from paddle_tpu.incubate.fleet.collective import LocalSGDSync
+
+        k = int(os.getenv("DIST_LOCALSGD"))
+        sync = LocalSGDSync(main_p, k_steps=k)
+        import paddle_tpu.executor as _ex
+
+        scope = _ex.global_scope()
+        for step in range(STEPS):
+            sl = slice(rank * local, (rank + 1) * local) if nranks > 1 \
+                else slice(None)
+            lv = exe.run(main_p, feed={kk: v[sl] for kk, v in feeds.items()},
+                         fetch_list=[loss])[0]
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+            sync.step(scope)
+        w = np.asarray(scope.find_var("d_fc1.w_0")).ravel()[:6].tolist()
+        print(f"PARAMS{rank} " + json.dumps(w), flush=True)
+    else:
+        for step in range(STEPS):
+            sl = slice(rank * local, (rank + 1) * local) if nranks > 1 \
+                else slice(None)
+            lv = exe.run(compiled, feed={k: v[sl] for k, v in feeds.items()},
+                         fetch_list=[loss])[0]
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
     if rank == 0:
         print("LOSSES " + json.dumps(losses), flush=True)
     return 0

@@ -1,0 +1,95 @@
+"""Quantization-aware training (reference contrib/slim/quantization/
+quantization_pass.py + fake_quantize_op.h)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.unique_name as un
+from op_test import OpTest
+from paddle_tpu.contrib.slim.quantization import quant_aware
+
+RNG = np.random.RandomState(7)
+
+
+class TestFakeQuantAbsMax(OpTest):
+    def setup(self):
+        v = RNG.randn(4, 6).astype(np.float32)
+        scale = np.abs(v).max()
+        q = np.round(np.clip(v / scale, -1, 1) * 127) / 127 * scale
+        self.op_type = "fake_quantize_dequantize_abs_max"
+        self.inputs = {"X": v}
+        self.outputs = {"Out": q.astype(np.float32),
+                        "OutScale": np.array([scale], np.float32)}
+
+    def test(self):
+        self.check_output(rtol=1e-6, atol=1e-7)
+
+
+def test_fake_quant_straight_through_gradient():
+    """STE: the analytic grad is identity (1/n for mean loss) even though
+    the true derivative of the staircase is 0 a.e. — finite differences
+    can't check this, so assert the property exactly."""
+    v = RNG.randn(3, 5).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[5], dtype="float32",
+                              stop_gradient=False)
+        blk = main.global_block
+        q = blk.create_var(name="q", dtype="float32")
+        s = blk.create_var(name="s", dtype="float32")
+        blk.append_op("fake_quantize_dequantize_abs_max",
+                      inputs={"X": "x"}, outputs={"Out": "q", "OutScale": "s"})
+        loss = fluid.layers.mean(q)
+        (gx,) = fluid.gradients([loss], [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (g,) = exe.run(main, feed={"x": v}, fetch_list=[gx.name])
+    np.testing.assert_allclose(np.asarray(g), np.full_like(v, 1 / v.size),
+                               rtol=1e-6)
+
+
+def test_quant_aware_training():
+    """QAT MNIST-ish MLP: fake-quant ops inserted on weights AND
+    activations, model still trains, and the quantized forward differs
+    from fp32 by a bounded amount (8-bit resolution)."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[16], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, 32, act="relu")
+            logits = fluid.layers.fc(h, 4)
+            test_prog = main.clone(for_test=True)
+            quant_aware(main, startup)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+    main.random_seed = 9
+
+    types = [op.type for op in main.global_block.ops]
+    assert types.count("fake_quantize_dequantize_abs_max") == 2  # 2 weights
+    assert types.count(
+        "fake_quantize_dequantize_moving_average_abs_max") >= 2  # acts
+
+    rng = np.random.RandomState(0)
+    xb = rng.randn(32, 16).astype(np.float32)
+    yb = (np.abs(xb[:, :4]).argmax(1)).astype(np.int64).reshape(-1, 1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(60):
+            (lv,) = exe.run(main, feed={"x": xb, "y": yb},
+                            fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        # quantized vs fp32 forward on the same trained params
+        (q_logits,) = exe.run(main, feed={"x": xb, "y": yb},
+                              fetch_list=[logits.name])
+        (f_logits,) = exe.run(test_prog, feed={"x": xb, "y": yb},
+                              fetch_list=[logits.name])
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    diff = np.abs(np.asarray(q_logits) - np.asarray(f_logits))
+    assert diff.max() > 0           # quantization actually changes values
+    assert diff.max() < 0.3         # ...but within 8-bit resolution
