@@ -1,3 +1,7 @@
 """fluid.contrib namespace (reference: python/paddle/fluid/contrib/)."""
 from . import mixed_precision  # noqa: F401
 from . import slim  # noqa: F401
+from .trainer import (CheckpointConfig, Trainer,  # noqa: F401
+                      BeginEpochEvent, BeginStepEvent, EndEpochEvent,
+                      EndStepEvent)
+from .inferencer import Inferencer  # noqa: F401

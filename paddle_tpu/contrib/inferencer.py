@@ -1,0 +1,3 @@
+"""reference python/paddle/contrib/inferencer.py — re-export; the class
+lives beside Trainer in trainer.py."""
+from .trainer import Inferencer  # noqa: F401

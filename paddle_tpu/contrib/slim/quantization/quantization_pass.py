@@ -43,7 +43,8 @@ class QuantizationTransformPass:
         new_ops = []
         for op in block.ops:
             if op.type in self.quantizable and \
-                    self.skip_pattern not in str(op.attrs.get("name", "")):
+                    self.skip_pattern not in str(
+                        op.attrs.get("op_namescope", "")):
                 for slot, names in op.inputs.items():
                     new_names = []
                     for name in names:

@@ -1,0 +1,185 @@
+"""High-level Trainer / Inferencer (reference
+python/paddle/contrib/trainer.py:169 Trainer with epoch/step events,
+:100 CheckpointConfig, :663 incremental save_checkpoint;
+python/paddle/contrib/inferencer.py:31 Inferencer).
+
+The event loop, checkpointing cadence and callbacks mirror the reference;
+execution rides the TPU executor (and CompiledProgram when num_devices>1).
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+from .. import io as io_mod
+from ..executor import CPUPlace, Executor, Scope, scope_guard
+from ..framework import Program, program_guard
+from ..parallel.compiled_program import CompiledProgram
+
+__all__ = ["Trainer", "Inferencer", "CheckpointConfig",
+           "BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
+           "EndStepEvent"]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch, self.step = epoch_id, step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch, self.step, self.metrics = epoch_id, step_id, metrics
+
+
+class CheckpointConfig:
+    """reference contrib/trainer.py:100."""
+
+    def __init__(self, checkpoint_dir: str, max_num_checkpoints: int = 3,
+                 epoch_interval: int = 1, step_interval: int = 10):
+        self.checkpoint_dir = checkpoint_dir
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(1, epoch_interval)
+        self.step_interval = max(1, step_interval)
+
+
+class Trainer:
+    """reference contrib/trainer.py:169: train_func returns the loss var
+    (after building the whole model under this trainer's programs)."""
+
+    def __init__(self, train_func: Callable, optimizer_func: Callable,
+                 place=None, checkpoint_config: Optional[CheckpointConfig]
+                 = None, parallel: bool = False):
+        self.main_program = Program()
+        self.startup_program = Program()
+        self._ckpt = checkpoint_config
+        with program_guard(self.main_program, self.startup_program):
+            loss = train_func()
+            if isinstance(loss, (list, tuple)):
+                loss = loss[0]
+            self.loss = loss
+            optimizer_func().minimize(loss)
+        self.place = place or CPUPlace()
+        self.exe = Executor(self.place)
+        self.scope = Scope()
+        self._parallel = parallel
+        self._step = 0
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+        if self._ckpt and self._serials():
+            self._load_latest()
+
+    # -- checkpoints -----------------------------------------------------
+    def _ckpt_path(self, serial: int) -> str:
+        return os.path.join(self._ckpt.checkpoint_dir, f"checkpoint_{serial}")
+
+    def _serials(self):
+        if not os.path.isdir(self._ckpt.checkpoint_dir):
+            return []
+        out = []
+        for n in os.listdir(self._ckpt.checkpoint_dir):
+            if n.startswith("checkpoint_"):
+                try:
+                    out.append(int(n.split("_")[1]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def _save_checkpoint(self):
+        serial = (self._serials()[-1] + 1) if self._serials() else 0
+        with scope_guard(self.scope):
+            io_mod.save_checkpoint(self.exe, self._ckpt_path(serial),
+                                   self.main_program,
+                                   meta={"step": self._step})
+        # rotate (reference keeps max_num_checkpoints)
+        for old in self._serials()[:-self._ckpt.max_num_checkpoints]:
+            import shutil
+
+            shutil.rmtree(self._ckpt_path(old), ignore_errors=True)
+
+    def _load_latest(self):
+        serial = self._serials()[-1]
+        with scope_guard(self.scope):
+            meta = io_mod.load_checkpoint(self.exe, self._ckpt_path(serial),
+                                          self.main_program)
+        self._step = int(meta.get("step", 0))
+
+    # -- the loop --------------------------------------------------------
+    def train(self, num_epochs: int, event_handler: Callable,
+              reader: Callable, feed_order):
+        from ..data_feeder import DataFeeder
+
+        feeder = DataFeeder(feed_list=list(feed_order),
+                            program=self.main_program)
+        prog = self.main_program
+        if self._parallel:
+            prog = CompiledProgram(self.main_program).with_data_parallel(
+                loss_name=self.loss.name)
+        with scope_guard(self.scope):
+            for epoch in range(num_epochs):
+                event_handler(BeginEpochEvent(epoch))
+                for step, batch in enumerate(reader()):
+                    begin = BeginStepEvent(epoch, step)
+                    event_handler(begin)
+                    fetches = [self.loss.name] if begin.fetch_metrics else []
+                    vals = self.exe.run(prog, feed=feeder.feed(batch),
+                                        fetch_list=fetches)
+                    metrics = [float(np.asarray(v).reshape(-1)[0])
+                               for v in vals]
+                    self._step += 1
+                    event_handler(EndStepEvent(epoch, step, metrics))
+                    if self._ckpt and self._step % \
+                            self._ckpt.step_interval == 0:
+                        self._save_checkpoint()
+                event_handler(EndEpochEvent(epoch))
+                if self._ckpt and (epoch + 1) % \
+                        self._ckpt.epoch_interval == 0:
+                    self._save_checkpoint()
+
+    def save_params(self, dirname: str):
+        with scope_guard(self.scope):
+            io_mod.save_params(self.exe, dirname, self.main_program)
+
+    def save_inference_model(self, dirname, feeded_var_names, target_vars):
+        with scope_guard(self.scope):
+            io_mod.save_inference_model(dirname, feeded_var_names,
+                                        target_vars, self.exe,
+                                        main_program=self.main_program)
+
+    def stop(self):
+        self.exe.close()
+
+
+class Inferencer:
+    """reference contrib/inferencer.py:31: infer_func rebuilds the forward
+    under fresh programs; params load from ``param_path``."""
+
+    def __init__(self, infer_func: Callable, param_path: str, place=None,
+                 parallel: bool = False):
+        self.main_program = Program()
+        self.startup_program = Program()
+        with program_guard(self.main_program, self.startup_program):
+            self.predict_var = infer_func()
+        self.exe = Executor(place or CPUPlace())
+        self.scope = Scope()
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            io_mod.load_params(self.exe, param_path, self.main_program)
+
+    def infer(self, inputs: dict):
+        with scope_guard(self.scope):
+            (out,) = self.exe.run(self.main_program, feed=inputs,
+                                  fetch_list=[self.predict_var.name])
+        return out

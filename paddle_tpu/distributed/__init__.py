@@ -132,3 +132,21 @@ def all_gather_object(arr):
 
     stacked = multihost_utils.process_allgather(np.asarray(arr))
     return [np.asarray(s) for s in stacked]
+
+
+def allgather_mean_tree(tree: dict) -> dict:
+    """Average a {key: ndarray} tree across processes in ONE collective
+    (identity single-process). Shared by LocalSGD and dygraph DataParallel
+    — the coalesced-allreduce primitive of the reference's collective
+    transpiler."""
+    import jax
+    import numpy as np
+
+    if jax.process_count() <= 1:
+        return dict(tree)
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        {k: np.asarray(v) for k, v in tree.items()}, tiled=False)
+    return {k: jax.numpy.asarray(np.mean(np.asarray(gathered[k]), axis=0))
+            for k in tree}

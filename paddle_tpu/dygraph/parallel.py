@@ -47,7 +47,7 @@ class DataParallel(Layer):
 
         if jax.process_count() <= 1:
             return
-        from jax.experimental import multihost_utils
+        from ..distributed import allgather_mean_tree
 
         with_grads = [p for p in self._layers.parameters()
                       if p._grad is not None]
@@ -56,12 +56,10 @@ class DataParallel(Layer):
         # keyed by POSITION in parameters() order — deterministic across
         # ranks; uids are process-local counters and can drift if any rank
         # created extra eager tensors
-        tree = {str(i): np.asarray(p._grad)
-                for i, p in enumerate(with_grads)}
-        gathered = multihost_utils.process_allgather(tree, tiled=False)
+        tree = allgather_mean_tree(
+            {str(i): p._grad for i, p in enumerate(with_grads)})
         for i, p in enumerate(with_grads):
-            p._grad = jax.numpy.asarray(
-                np.mean(np.asarray(gathered[str(i)]), axis=0))
+            p._grad = tree[str(i)]
 
     # -- delegation --------------------------------------------------------
     def parameters(self):

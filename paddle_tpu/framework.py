@@ -399,6 +399,10 @@ class Block:
                 # reference framework/op_call_stack.h: the op remembers the
                 # user line that created it; lowering errors point here
                 op.attrs["op_callstack"] = site
+        if _name_scope_stack and "op_namescope" not in op.attrs:
+            # reference op_proto_maker OpNamescopeAttrName: consumed by e.g.
+            # the slim quant pass's skip_pattern
+            op.attrs["op_namescope"] = "/".join(_name_scope_stack)
 
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
         op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)

@@ -180,12 +180,7 @@ class LocalSGDSync:
         self._count += 1
         if self._count % self._k:
             return False
-        import jax
-
-        if jax.process_count() <= 1:
-            return True  # single process: averaging is the identity
-        import numpy as np
-        from jax.experimental import multihost_utils
+        from ....distributed import allgather_mean_tree
 
         tree = {}
         for n in self._names:
@@ -194,11 +189,9 @@ class LocalSGDSync:
                 raise RuntimeError(
                     f"LocalSGDSync: parameter '{n}' not initialized in "
                     f"scope — run the startup program first")
-            tree[n] = np.asarray(v)
-        gathered = multihost_utils.process_allgather(tree, tiled=False)
-        for n in self._names:
-            scope.set_var(n, jax.numpy.asarray(
-                np.mean(np.asarray(gathered[n]), axis=0)))
+            tree[n] = v
+        for n, v in allgather_mean_tree(tree).items():
+            scope.set_var(n, v)
         return True
 
 

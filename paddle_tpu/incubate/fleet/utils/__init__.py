@@ -1,0 +1,1 @@
+from . import fs  # noqa: F401

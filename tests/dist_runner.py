@@ -61,11 +61,6 @@ def main():
 
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
-    bs = fluid.BuildStrategy()
-    if os.getenv("DIST_REDUCE") == "1":
-        bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
-    compiled = fluid.CompiledProgram(main_p).with_data_parallel(
-        loss_name=loss.name, build_strategy=bs)
 
     local = GLOBAL_BATCH // nranks
     losses = []
@@ -88,6 +83,11 @@ def main():
         w = np.asarray(scope.find_var("d_fc1.w_0")).ravel()[:6].tolist()
         print(f"PARAMS{rank} " + json.dumps(w), flush=True)
     else:
+        bs = fluid.BuildStrategy()
+        if os.getenv("DIST_REDUCE") == "1":
+            bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+        compiled = fluid.CompiledProgram(main_p).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
         for step in range(STEPS):
             sl = slice(rank * local, (rank + 1) * local) if nranks > 1 \
                 else slice(None)

@@ -1,0 +1,94 @@
+"""High-level Trainer/Inferencer + fs shim (reference contrib/trainer.py,
+contrib/inferencer.py, framework/io/fs.h)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.unique_name as un
+
+
+def _train_func():
+    x = fluid.layers.data("x", shape=[13], dtype="float32")
+    y = fluid.layers.data("y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, 1, name="fit")
+    return fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+
+
+def _infer_func():
+    x = fluid.layers.data("x", shape=[13], dtype="float32")
+    return fluid.layers.fc(x, 1, name="fit")
+
+
+def test_trainer_events_checkpoints_and_inferencer(tmp_path):
+    from paddle_tpu.dataset import uci_housing
+
+    events = {"epochs": 0, "steps": 0, "losses": []}
+
+    def handler(ev):
+        if isinstance(ev, fluid.contrib.EndEpochEvent):
+            events["epochs"] += 1
+        elif isinstance(ev, fluid.contrib.EndStepEvent):
+            events["steps"] += 1
+            if ev.metrics:
+                events["losses"].append(ev.metrics[0])
+
+    ckpt = fluid.contrib.CheckpointConfig(str(tmp_path / "ckpt"),
+                                          max_num_checkpoints=2,
+                                          step_interval=5)
+    with un.guard():
+        trainer = fluid.contrib.Trainer(_train_func,
+                                        lambda: fluid.optimizer.SGD(0.01),
+                                        checkpoint_config=ckpt)
+        reader = fluid.reader.batch(uci_housing.train(), batch_size=32,
+                                    drop_last=True)
+        trainer.train(num_epochs=3, event_handler=handler, reader=reader,
+                      feed_order=["x", "y"])
+        trainer.save_params(str(tmp_path / "params"))
+    assert events["epochs"] == 3 and events["steps"] > 10
+    assert events["losses"][-1] < events["losses"][0]
+    # rotation kept at most 2 checkpoints
+    kept = [n for n in os.listdir(str(tmp_path / "ckpt"))
+            if n.startswith("checkpoint_")]
+    assert 0 < len(kept) <= 2
+
+    with un.guard():
+        inf = fluid.contrib.Inferencer(_infer_func,
+                                       str(tmp_path / "params"))
+    xb = np.random.RandomState(0).randn(4, 13).astype(np.float32)
+    out = inf.infer({"x": xb})
+    assert np.asarray(out).shape == (4, 1)
+
+    # resume: a fresh trainer on the same ckpt dir restores the step count
+    with un.guard():
+        t2 = fluid.contrib.Trainer(_train_func,
+                                   lambda: fluid.optimizer.SGD(0.01),
+                                   checkpoint_config=ckpt)
+    assert t2._step > 0
+
+
+def test_local_fs():
+    from paddle_tpu.incubate.fleet.utils.fs import LocalFS
+
+    fs = LocalFS()
+    import tempfile
+
+    d = tempfile.mkdtemp()
+    fs.mkdirs(os.path.join(d, "a/b"))
+    assert fs.is_dir(os.path.join(d, "a/b"))
+    p = os.path.join(d, "a/b/f.txt")
+    fs.touch(p)
+    assert fs.is_file(p) and fs.ls_dir(os.path.join(d, "a/b")) == ["f.txt"]
+    fs.mv(p, os.path.join(d, "a/g.txt"))
+    assert fs.cat(os.path.join(d, "a/g.txt")) == ""
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_hdfs_client_clear_error_without_hadoop():
+    from paddle_tpu.incubate.fleet.utils.fs import HDFSClient
+
+    c = HDFSClient(hadoop_home="/nonexistent")
+    with pytest.raises(RuntimeError, match="hadoop binary not found"):
+        c.mkdirs("/tmp/x")

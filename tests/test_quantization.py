@@ -93,3 +93,19 @@ def test_quant_aware_training():
     diff = np.abs(np.asarray(q_logits) - np.asarray(f_logits))
     assert diff.max() > 0           # quantization actually changes values
     assert diff.max() < 0.3         # ...but within 8-bit resolution
+
+
+def test_skip_pattern_respects_name_scope():
+    """Ops created under fluid.name_scope('skip_quant') are excluded
+    (reference checks the op namescope)."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            h = fluid.layers.fc(x, 8)
+            with fluid.name_scope("skip_quant"):
+                out = fluid.layers.fc(h, 4)
+            quant_aware(main, startup)
+    types = [op.type for op in main.global_block.ops]
+    # only the first fc's weight+activation got quantized
+    assert types.count("fake_quantize_dequantize_abs_max") == 1
