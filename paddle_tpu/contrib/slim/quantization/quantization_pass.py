@@ -62,6 +62,12 @@ class QuantizationTransformPass:
                         new_names.append(quantized_of[name])
                     op.inputs[slot] = new_names
             new_ops.append(op)
+            # A name this op (re)defines invalidates any cached fake-quant
+            # of it: a later consumer must quantize the NEW value, not the
+            # stale one computed from the earlier definition.
+            for names in op.outputs.values():
+                for name in names:
+                    quantized_of.pop(name, None)
         block.ops = new_ops
         program._bump_version()
         return n

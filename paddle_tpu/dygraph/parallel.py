@@ -49,16 +49,24 @@ class DataParallel(Layer):
             return
         from ..distributed import allgather_mean_tree
 
-        with_grads = [p for p in self._layers.parameters()
-                      if p._grad is not None]
-        if not with_grads:
+        import jax.numpy as jnp
+
+        params = list(self._layers.parameters())
+        if not any(p._grad is not None for p in params):
             return
-        # keyed by POSITION in parameters() order — deterministic across
-        # ranks; uids are process-local counters and can drift if any rank
-        # created extra eager tensors
+        # keyed by POSITION over ALL parameters() — not just the with-grad
+        # subset, whose membership can differ across ranks (a conditional
+        # path or unused parameter on one rank would silently misalign the
+        # averages). Ranks where a parameter has no grad contribute zeros,
+        # which is the correct term for an unused parameter.
         tree = allgather_mean_tree(
-            {str(i): p._grad for i, p in enumerate(with_grads)})
-        for i, p in enumerate(with_grads):
+            {str(i): (p._grad if p._grad is not None
+                      else jnp.zeros(p.shape, p.dtype))
+             for i, p in enumerate(params)})
+        # write back UNCONDITIONALLY (standard DDP semantics): a rank whose
+        # conditional path skipped this parameter must still apply the same
+        # averaged grad, or its copy diverges from the other ranks'.
+        for i, p in enumerate(params):
             p._grad = tree[str(i)]
 
     # -- delegation --------------------------------------------------------

@@ -93,20 +93,27 @@ def _prior_box(ctx, ins, attrs):
                     "stride": [16.0, 16.0], "offset": 0.5},
              grad=None)
 def _anchor_generator(ctx, ins, attrs):
-    """reference anchor_generator_op.h: RPN anchors in pixel coords."""
+    """reference anchor_generator_op.h:55-84: RPN anchors in pixel coords.
+
+    Legacy pixel conventions matter for parity with reference-trained RPN
+    heads: centers at idx*stride + offset*(stride-1), base_w/base_h
+    quantized through round(sqrt(stride_area/ar)), corners at
+    ctr +/- 0.5*(wh-1)."""
     feat = x(ins, "Input")
     H, W = feat.shape[2], feat.shape[3]
     sw, sh = attrs["stride"]
     offset = attrs["offset"]
-    cx = (jnp.arange(W) + offset) * sw
-    cy = (jnp.arange(H) + offset) * sh
+    cx = jnp.arange(W) * sw + offset * (sw - 1)
+    cy = jnp.arange(H) * sh + offset * (sh - 1)
     cxg, cyg = jnp.meshgrid(cx, cy)
     whs = []
     for ar in attrs["aspect_ratios"]:
         for size in attrs["anchor_sizes"]:
-            area = size * size
-            w = np.sqrt(area / ar)
-            whs.append((0.5 * w, 0.5 * w * ar))
+            base_w = np.round(np.sqrt(sw * sh / ar))
+            base_h = np.round(base_w * ar)
+            anchor_w = (size / sw) * base_w
+            anchor_h = (size / sh) * base_h
+            whs.append((0.5 * (anchor_w - 1), 0.5 * (anchor_h - 1)))
     bw = jnp.asarray([w for w, _ in whs], feat.dtype)
     bh = jnp.asarray([h for _, h in whs], feat.dtype)
     anchors = jnp.stack([cxg[..., None] - bw, cyg[..., None] - bh,

@@ -202,3 +202,42 @@ def test_roi_align_and_pool_shapes_and_values():
     # align values sit inside the feature's range and grow along the roi
     av = np.asarray(a)[0, 0]
     assert av[0, 0] < av[1, 1] and 0 <= av.min() and av.max() <= 15
+
+
+class TestAnchorGenerator(OpTest):
+    """Golden-value oracle mirroring the reference anchor_generator_op.h
+    loop verbatim: legacy pixel conventions (offset*(stride-1) centers,
+    round()-quantized base sizes, +/-0.5*(wh-1) corners)."""
+
+    def setup(self):
+        feat = RNG.randn(1, 8, 3, 2).astype(np.float32)  # H=3, W=2
+        sizes, ars = [32.0, 64.0], [0.5, 1.0]
+        sw, sh, offset = 16.0, 16.0, 0.5
+        P = len(sizes) * len(ars)
+        anchors = np.zeros((3, 2, P, 4), np.float32)
+        for h in range(3):
+            for w in range(2):
+                x_ctr = w * sw + offset * (sw - 1)
+                y_ctr = h * sh + offset * (sh - 1)
+                idx = 0
+                for ar in ars:
+                    for size in sizes:
+                        base_w = np.round(np.sqrt(sw * sh / ar))
+                        base_h = np.round(base_w * ar)
+                        aw = (size / sw) * base_w
+                        ah = (size / sh) * base_h
+                        anchors[h, w, idx] = [x_ctr - 0.5 * (aw - 1),
+                                              y_ctr - 0.5 * (ah - 1),
+                                              x_ctr + 0.5 * (aw - 1),
+                                              y_ctr + 0.5 * (ah - 1)]
+                        idx += 1
+        var = np.broadcast_to(np.array([0.1, 0.1, 0.2, 0.2], np.float32),
+                              anchors.shape)
+        self.op_type = "anchor_generator"
+        self.inputs = {"Input": feat}
+        self.attrs = {"anchor_sizes": sizes, "aspect_ratios": ars,
+                      "stride": [sw, sh], "offset": offset}
+        self.outputs = {"Anchors": anchors, "Variances": np.array(var)}
+
+    def test(self):
+        self.check_output(rtol=1e-5, atol=1e-4)

@@ -177,3 +177,35 @@ def test_dygraph_grad_accumulation_and_clear():
         np.testing.assert_allclose(fc.weight.gradient(), 2 * g1, rtol=1e-6)
         fc.clear_gradients()
         assert fc.weight.gradient() is None
+
+
+def test_dygraph_lamb_is_real_lamb():
+    """Regression (advisor r3): LambOptimizer's eager path must apply the
+    trust-ratio-scaled lamb rule (via the 'lamb' registry lowering), not a
+    plain Adam update inherited from AdamOptimizer."""
+    from paddle_tpu.optimizer import LambOptimizer
+
+    with dygraph.guard():
+        fc = dygraph.nn.FC(4, 4)
+        x = dygraph.to_variable(np.ones((2, 4), np.float32))
+        opt = LambOptimizer(learning_rate=0.1, lamb_weight_decay=0.01)
+        loss = dygraph.ops.reduce_mean(fc(x))
+        loss.backward()
+        params = list(fc.parameters())
+        before = {p.name: np.array(p.value) for p in params}
+        grads = {p.name: (np.array(p._grad) if p._grad is not None else None)
+                 for p in params}
+        opt.minimize(loss, parameter_list=params)
+        for p in params:
+            g, w = grads[p.name], before[p.name]
+            if g is None:
+                continue
+            b1, b2, eps, wd = 0.9, 0.999, 1e-6, 0.01
+            m1h = ((1 - b1) * g) / (1 - b1)
+            m2h = ((1 - b2) * g * g) / (1 - b2)
+            r = m1h / (np.sqrt(m2h) + eps) + wd * w
+            wn = np.sqrt((w ** 2).sum())
+            rn = np.sqrt((r ** 2).sum())
+            ratio = wn / rn if (wn > 0 and rn > 0) else 1.0
+            np.testing.assert_allclose(np.array(p.value),
+                                       w - 0.1 * ratio * r, atol=1e-5)

@@ -109,3 +109,33 @@ def test_skip_pattern_respects_name_scope():
     types = [op.type for op in main.global_block.ops]
     # only the first fc's weight+activation got quantized
     assert types.count("fake_quantize_dequantize_abs_max") == 1
+
+
+def test_requantize_after_inplace_rewrite():
+    """A var name re-defined by a later op must be re-quantized for later
+    consumers — the per-name cache is invalidated at each redefinition
+    (advisor finding: stale quantized value reused otherwise)."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            h1 = fluid.layers.fc(x, 8)
+            # re-define h1's name in place via scale writing to same var
+            blk = main.global_block
+            blk.append_op("scale", inputs={"X": h1},
+                          outputs={"Out": h1}, attrs={"scale": 2.0})
+            out = fluid.layers.fc(h1, 4)  # consumes the REDEFINED h1
+            quant_aware(main, startup)
+    ops = main.global_block.ops
+    # find the second fc's mul: its X input must be a .quantized name that
+    # was produced AFTER the in-place scale op
+    scale_idx = [i for i, op in enumerate(ops) if op.type == "scale"][0]
+    muls = [i for i, op in enumerate(ops) if op.type == "mul"]
+    second_mul = [i for i in muls if i > scale_idx][0]
+    qname = ops[second_mul].inputs["X"][0]
+    assert ".quantized" in qname
+    producer = [i for i, op in enumerate(ops)
+                if qname in sum(op.outputs.values(), [])][0]
+    assert producer > scale_idx, (
+        "second fc consumes a fake-quant computed before the in-place "
+        "redefinition — stale value")
