@@ -51,19 +51,22 @@ class DataParallel(Layer):
 
         import jax.numpy as jnp
 
-        params = list(self._layers.parameters())
+        # keyed by POSITION over all TRAINABLE parameters() — not just the
+        # with-grad subset, whose membership can differ across ranks (a
+        # conditional path or unused parameter on one rank would silently
+        # misalign the averages). stop_gradient params (BatchNorm running
+        # stats) never take part: giving them a zero grad would flip them
+        # from frozen to optimizer-updated. Ranks where a trainable param
+        # has no grad contribute zeros — the correct term for unused.
+        params = [p for p in self._layers.parameters()
+                  if not getattr(p, "stop_gradient", False)]
         if not any(p._grad is not None for p in params):
             return
-        # keyed by POSITION over ALL parameters() — not just the with-grad
-        # subset, whose membership can differ across ranks (a conditional
-        # path or unused parameter on one rank would silently misalign the
-        # averages). Ranks where a parameter has no grad contribute zeros,
-        # which is the correct term for an unused parameter.
         tree = allgather_mean_tree(
             {str(i): (p._grad if p._grad is not None
                       else jnp.zeros(p.shape, p.dtype))
              for i, p in enumerate(params)})
-        # write back UNCONDITIONALLY (standard DDP semantics): a rank whose
+        # write back unconditionally (standard DDP semantics): a rank whose
         # conditional path skipped this parameter must still apply the same
         # averaged grad, or its copy diverges from the other ranks'.
         for i, p in enumerate(params):

@@ -20,6 +20,16 @@ _DEFS: Dict[str, tuple] = {
                       "(reference flags.cc:44; operator.cc fast_check_nan_inf)"),
     "paddle_num_threads": (int, 1, "host threads hint (XLA owns scheduling)"),
     "seq_bucket_sizes": (str, "", "override DataFeeder varlen buckets, csv"),
+    "conv_use_nhwc": (str, "auto",
+                      "conv/pool inner layout: auto (NHWC on TPU — channels "
+                      "ride the 128-lane dim; boundary transposes cancel "
+                      "between layers), always, never (NCHW as the "
+                      "reference)"),
+    "use_flash_attention": (str, "auto",
+                            "fused_multihead_attention path: auto (Pallas "
+                            "kernel on TPU, primitives elsewhere), always "
+                            "(force kernel; interpret mode off-TPU — slow, "
+                            "tests only), never"),
     # accepted-for-compat, inert on TPU (XLA/PJRT owns memory)
     "fraction_of_gpu_memory_to_use": (float, 0.92, "inert: XLA preallocates"),
     "allocator_strategy": (str, "auto_growth", "inert: XLA buffer assignment"),

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels — the reference's `operators/jit/` + `operators/fused/`
+role (xbyak runtime codegen and hand-fused kernels) rebuilt as Mosaic
+kernels. Everything here must also run under `interpret=True` on CPU (minus
+PRNG-dependent paths) so numerics are testable without hardware."""
+from .flash_attention import (flash_attention, flash_attention_with_lse,
+                              supports_shapes)
+
+__all__ = ["flash_attention", "flash_attention_with_lse", "supports_shapes"]

@@ -1,0 +1,480 @@
+"""Flash attention as a Pallas TPU kernel — the `jit/` + `fused/` role.
+
+This is the TPU-native analogue of the reference's runtime-codegen fused
+kernels (reference: paddle/fluid/operators/jit/kernel_base.h xbyak JIT
+framework; paddle/fluid/operators/fused/fused_embedding_fc_lstm_op.cc etc.):
+the one place SURVEY §7 reserves hand-written kernels because whole-graph XLA
+fusion cannot produce them. The kernel computes
+
+    O = dropout(softmax(Q K^T * scale + bias + causal_mask)) V
+
+blockwise with the online-softmax recurrence, never materialising the
+[S, S] score matrix in HBM: scores live in VMEM one (block_q, block_k)
+tile at a time, accumulators persist in VMEM scratch across the innermost
+grid dimension (TPU grid steps execute sequentially per core, so scratch
+carries state the way the reference's xbyak kernels carry registers).
+
+Design notes
+- Layout is [B*H, S, D] (head-major): one grid axis ranges over fused
+  batch*heads, blocks tile the sequence. D (head_dim) rides the lane
+  dimension; 64/128 both work (64 pads lanes — bert-base's 768/12).
+- The backward is the standard two-kernel flash split: dQ with the q-block
+  as the outer tile, dK/dV with the k-block outer, both recomputing
+  P = exp(S - lse) from the saved log-sum-exp rather than storing probs.
+- The function also RETURNS lse, and its VJP accepts a cotangent for it:
+  d lse_i / d S_ij = P_ij, so the lse cotangent just joins the
+  `(dP - delta)` term. This is what lets ring attention combine per-block
+  kernel results across ICI steps and still differentiate end-to-end.
+- Dropout uses the on-core PRNG (`pltpu.prng_seed` / `prng_random_bits`),
+  reseeded per (bh, q-block, k-block) so the backward kernels regenerate
+  bit-identical keep masks. The PRNG has no interpret-mode lowering, so
+  dropout>0 requires a real TPU; callers fall back to the primitive path
+  elsewhere (ops/fused_attention.py).
+- Masked-out rows (a fully-padded query) produce O=0 and lse=-inf; the
+  backward guards exp(s - lse) with a finite sentinel so their grads are
+  exactly zero.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "flash_attention_with_lse", "supports_shapes"]
+
+NEG_INF = -1e30          # finite sentinel: (-inf) - (-inf) would NaN
+# odd mixing constants for per-block reseeding, pre-wrapped to int32 range
+# (jax int32 multiply wraps, which is exactly the mixing we want)
+_SEED_MIX_BH = -1640532047   # int32(0x9E3779B1)
+_SEED_MIX_Q = -2048144777    # int32(0x85EBCA77)
+_SEED_MIX_K = -1028477379    # int32(0xC2B2AE3D)
+
+
+@dataclasses.dataclass(frozen=True)
+class _Cfg:
+    """Static kernel configuration (hashable: custom_vjp nondiff arg)."""
+
+    causal: bool
+    scale: float
+    dropout: float
+    block_q: int
+    block_k: int
+    num_heads: int       # for bias [B, Sk] indexing from the fused B*H axis
+    has_bias: bool
+    interpret: bool
+    # 'highest' for f32 inputs (true f32 multiplies), 'default' for bf16
+    # (native MXU one-pass mode)
+    precision: str
+
+
+def supports_shapes(sq: int, sk: int, block_q: int = 128,
+                    block_k: int = 128) -> bool:
+    """Kernel requires sequence lengths divisible by the block sizes."""
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    return sq % bq == 0 and sk % bk == 0
+
+
+def _out_sds(shape, dtype, *like):
+    """ShapeDtypeStruct for pallas outputs; under shard_map (check_vma=True)
+    outputs must declare which mesh axes they vary over — the union of the
+    operands'."""
+    vma = set()
+    for t in like:
+        try:
+            v = getattr(jax.typeof(t), "vma", None)
+        except Exception:
+            v = None
+        if v:
+            vma |= set(v)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _rows8(x):
+    """[N, S] row vector -> [N, 8, S], replicated over the sublane dim.
+    Mosaic block shapes need their second-to-last dim divisible by 8 (f32);
+    a (1, block) tile of a 2-D array violates that, a (1, 8, block) tile of
+    the replicated form doesn't. XLA materialises the broadcast lazily."""
+    return jnp.broadcast_to(x[:, None, :], (x.shape[0], 8, x.shape[1]))
+
+
+def _dropout_keep(seed, bh, iq, ik, shape, rate):
+    """Deterministic per-block keep mask from the on-core PRNG."""
+    mix = (seed + bh * _SEED_MIX_BH + iq * _SEED_MIX_Q + ik * _SEED_MIX_K)
+    pltpu.prng_seed(mix)
+    # raw bits are int32; Mosaic has no uint32->f32 cast, so mask to the
+    # low 23 bits (non-negative in int32) -> uniform [0, 1)
+    bits = pltpu.prng_random_bits(shape) & 0x007FFFFF
+    u = bits.astype(jnp.float32) * (1.0 / (1 << 23))
+    return u >= rate
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(cfg: _Cfg, scal_ref, *refs):
+    if cfg.has_bias:
+        q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, m_scr, l_scr, acc = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc = refs
+        b_ref = None
+    bh, iq, ik = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc[:] = jnp.zeros_like(acc)
+
+    q = q_ref[0]                                   # [bq, D]
+    k = k_ref[0]                                   # [bk, D]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=cfg.precision)
+    s = s * cfg.scale                              # [bq, bk] f32
+    if cfg.has_bias:
+        s = s + b_ref[0, 0].astype(jnp.float32)[None, :]
+    if cfg.causal:
+        q_pos = (scal_ref[0] + iq * cfg.block_q
+                 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+        k_pos = (scal_ref[1] + ik * cfg.block_k
+                 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+    m_prev = m_scr[:, :1]                          # [bq, 1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alive = m_new > NEG_INF * 0.5
+    m_safe = jnp.where(alive, m_new, 0.0)
+    corr = jnp.exp(m_prev - m_safe)                # underflows to 0 if dead
+    p = jnp.exp(s - m_safe)                        # masked s -> exp(-1e30)=0
+    l_new = corr * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+    if cfg.dropout > 0.0:
+        keep = _dropout_keep(scal_ref[2], bh, iq, ik, s.shape, cfg.dropout)
+        p = jnp.where(keep, p / (1.0 - cfg.dropout), 0.0)
+    pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                            precision=cfg.precision)
+    acc[:] = acc[:] * corr + pv
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == num_k - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        lse_row = jnp.where(l[:, 0] > 0.0,
+                            m_scr[:, 0] + jnp.log(l[:, 0]), -jnp.inf)
+        # row vectors are stored sublane-replicated [8, block_q]: Mosaic
+        # requires block sublanes divisible by 8 (see _rows8)
+        lse_ref[0] = jnp.broadcast_to(lse_row[None, :], lse_ref.shape[1:])
+
+
+def _fwd(cfg: _Cfg, q, k, v, bias, scalars):
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    nq, nk = Sq // cfg.block_q, Sk // cfg.block_k
+    in_specs = [
+        pl.BlockSpec((1, cfg.block_q, D), lambda bh, iq, ik, s: (bh, iq, 0)),
+        pl.BlockSpec((1, cfg.block_k, D), lambda bh, iq, ik, s: (bh, ik, 0)),
+        pl.BlockSpec((1, cfg.block_k, D), lambda bh, iq, ik, s: (bh, ik, 0)),
+    ]
+    args = [q, k, v]
+    if cfg.has_bias:
+        H = cfg.num_heads
+        in_specs.append(pl.BlockSpec((1, 8, cfg.block_k),
+                                     lambda bh, iq, ik, s: (bh // H, 0, ik)))
+        args.append(_rows8(bias))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(BH, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, cfg.block_q, D),
+                         lambda bh, iq, ik, s: (bh, iq, 0)),
+            pl.BlockSpec((1, 8, cfg.block_q),
+                         lambda bh, iq, ik, s: (bh, 0, iq)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((cfg.block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((cfg.block_q, 128), jnp.float32),   # running denom
+            pltpu.VMEM((cfg.block_q, D), jnp.float32),     # numerator acc
+        ],
+    )
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, cfg),
+        grid_spec=grid_spec,
+        out_shape=[
+            _out_sds((BH, Sq, D), q.dtype, q, k, v),
+            _out_sds((BH, 8, Sq), jnp.float32, q, k, v),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=cfg.interpret,
+    )(scalars, *args)
+    return o, lse[:, 0, :]
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+def _recompute_p(cfg, scal_ref, q, k, b_ref, lse, iq, ik):
+    """P = exp(S - lse) for one tile, shared by both backward kernels."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=cfg.precision) * cfg.scale
+    if cfg.has_bias:
+        s = s + b_ref[0, 0].astype(jnp.float32)[None, :]
+    if cfg.causal:
+        q_pos = (scal_ref[0] + iq * cfg.block_q
+                 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+        k_pos = (scal_ref[1] + ik * cfg.block_k
+                 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    lse_safe = jnp.where(jnp.isfinite(lse), lse, -NEG_INF)  # dead rows: p=0
+    return jnp.exp(s - lse_safe[:, None])
+
+
+def _dq_kernel(cfg: _Cfg, scal_ref, *refs):
+    if cfg.has_bias:
+        (q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref, dq_ref,
+         dq_acc) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, dq_acc = refs
+        b_ref = None
+    bh, iq, ik = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    p = _recompute_p(cfg, scal_ref, q_ref[0], k_ref[0], b_ref,
+                     lse_ref[0, 0], iq, ik)
+    do = do_ref[0]
+    dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                            precision=cfg.precision)
+    if cfg.dropout > 0.0:
+        keep = _dropout_keep(scal_ref[2], bh, iq, ik, p.shape, cfg.dropout)
+        dp = jnp.where(keep, dp / (1.0 - cfg.dropout), 0.0)
+    ds = p * (dp - dl_ref[0, 0].astype(jnp.float32)[:, None])
+    dq_acc[:] += cfg.scale * jax.lax.dot_general(
+        ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+                            precision=cfg.precision)
+
+    @pl.when(ik == num_k - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(cfg: _Cfg, scal_ref, *refs):
+    if cfg.has_bias:
+        (q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref, dk_ref,
+         dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref, dv_ref,
+         dk_acc, dv_acc) = refs
+        b_ref = None
+    bh, ik, iq = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    num_q = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0]
+    p = _recompute_p(cfg, scal_ref, q, k_ref[0], b_ref, lse_ref[0, 0],
+                     iq, ik)
+    do = do_ref[0]
+    dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                            precision=cfg.precision)
+    p_used = p
+    if cfg.dropout > 0.0:
+        keep = _dropout_keep(scal_ref[2], bh, iq, ik, p.shape, cfg.dropout)
+        inv = 1.0 / (1.0 - cfg.dropout)
+        p_used = jnp.where(keep, p * inv, 0.0)
+        dp = jnp.where(keep, dp * inv, 0.0)
+    # dV = P_dropped^T @ dO
+    dv_acc[:] += jax.lax.dot_general(
+        p_used.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+                            precision=cfg.precision)
+    ds = p * (dp - dl_ref[0, 0].astype(jnp.float32)[:, None])
+    dk_acc[:] += cfg.scale * jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+                            precision=cfg.precision)
+
+    @pl.when(iq == num_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(cfg: _Cfg, q, k, v, bias, scalars, do, lse, delta):
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    nq, nk = Sq // cfg.block_q, Sk // cfg.block_k
+    qspec = pl.BlockSpec((1, cfg.block_q, D),
+                         lambda bh, iq, ik, s: (bh, iq, 0))
+    kspec = pl.BlockSpec((1, cfg.block_k, D),
+                         lambda bh, iq, ik, s: (bh, ik, 0))
+    rowspec = pl.BlockSpec((1, 8, cfg.block_q),
+                           lambda bh, iq, ik, s: (bh, 0, iq))
+    args = [q, k, v]
+    common = [qspec, kspec, kspec]
+    if cfg.has_bias:
+        H = cfg.num_heads
+        common.append(pl.BlockSpec((1, 8, cfg.block_k),
+                                   lambda bh, iq, ik, s: (bh // H, 0, ik)))
+        args.append(_rows8(bias))
+    common += [qspec, rowspec, rowspec]            # do, lse, delta
+    args += [do, _rows8(lse), _rows8(delta)]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, cfg),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, nq, nk),
+            in_specs=common,
+            out_specs=[qspec],
+            scratch_shapes=[pltpu.VMEM((cfg.block_q, D), jnp.float32)],
+        ),
+        out_shape=[_out_sds((BH, Sq, D), q.dtype, q, k, v, do)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=cfg.interpret,
+    )(scalars, *args)[0]
+
+    # k-outer grid: swap the roles of the q/k grid axes in the index maps
+    qspec2 = pl.BlockSpec((1, cfg.block_q, D),
+                          lambda bh, ik, iq, s: (bh, iq, 0))
+    kspec2 = pl.BlockSpec((1, cfg.block_k, D),
+                          lambda bh, ik, iq, s: (bh, ik, 0))
+    rowspec2 = pl.BlockSpec((1, 8, cfg.block_q),
+                            lambda bh, ik, iq, s: (bh, 0, iq))
+    common2 = [qspec2, kspec2, kspec2]
+    if cfg.has_bias:
+        H = cfg.num_heads
+        common2.append(pl.BlockSpec((1, 8, cfg.block_k),
+                                    lambda bh, ik, iq, s: (bh // H, 0, ik)))
+    common2 += [qspec2, rowspec2, rowspec2]
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, cfg),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, nk, nq),
+            in_specs=common2,
+            out_specs=[kspec2, kspec2],
+            scratch_shapes=[pltpu.VMEM((cfg.block_k, D), jnp.float32),
+                            pltpu.VMEM((cfg.block_k, D), jnp.float32)],
+        ),
+        out_shape=[_out_sds((BH, Sk, D), k.dtype, q, k, v, do),
+                   _out_sds((BH, Sk, D), v.dtype, q, k, v, do)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=cfg.interpret,
+    )(scalars, *args)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# custom-vjp wrapper
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(cfg: _Cfg, q, k, v, bias, scalars):
+    return _fwd(cfg, q, k, v, bias, scalars)
+
+
+def _flash_fwd_rule(cfg, q, k, v, bias, scalars):
+    o, lse = _fwd(cfg, q, k, v, bias, scalars)
+    return (o, lse), (q, k, v, bias, scalars, o, lse)
+
+
+def _flash_bwd_rule(cfg, res, cts):
+    q, k, v, bias, scalars, o, lse = res
+    do, dlse = cts
+    # delta_i = sum_d dO_id * O_id  = rowsum(P_dropped * dP); the lse
+    # cotangent enters the same P-weighted term (d lse/dS = P), so it folds
+    # in by subtraction.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = delta - dlse.astype(jnp.float32)
+    dq, dk, dv = _bwd(cfg, q, k, v, bias, scalars, do, lse, delta)
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_with_lse(q, k, v, bias: Optional[jax.Array] = None,
+                             causal: bool = False,
+                             scale: Optional[float] = None,
+                             dropout_rate: float = 0.0,
+                             seed=0,
+                             q_offset=0, k_offset=0,
+                             num_heads: int = 1,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: bool = False):
+    """Flash attention over [B*H, S, D] tensors; returns (O, lse).
+
+    ``bias`` is an additive [B, Sk] key bias (the padding-mask encoding —
+    models/bert.py builds (mask-1)*10000 exactly like this); ``num_heads``
+    tells the kernel how the leading B*H axis factors so bias rows map to
+    batches. ``q_offset``/``k_offset`` (may be traced scalars) shift the
+    causal comparison to GLOBAL positions for ring attention. ``lse`` is the
+    per-row log-sum-exp; its cotangent is honoured, so blockwise
+    combinations that re-weight through lse differentiate correctly.
+    """
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    bq, bk = min(block_q, Sq), min(block_k, Sk)
+    if Sq % bq or Sk % bk:
+        raise ValueError(
+            f"flash_attention needs seq lengths divisible by block sizes: "
+            f"Sq={Sq} bq={bq} Sk={Sk} bk={bk}")
+    if dropout_rate > 0.0 and interpret:
+        raise NotImplementedError(
+            "in-kernel dropout uses the TPU PRNG which has no interpret-"
+            "mode lowering; use the primitive fallback path off-TPU")
+    cfg = _Cfg(causal=bool(causal),
+               scale=float(scale if scale is not None else D ** -0.5),
+               dropout=float(dropout_rate),
+               block_q=bq, block_k=bk,
+               num_heads=int(num_heads), has_bias=bias is not None,
+               interpret=bool(interpret),
+               precision=("highest" if q.dtype == jnp.float32
+                          else "default"))
+    scalars = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(k_offset, jnp.int32),
+                         jnp.asarray(seed, jnp.int32)])
+    return _flash(cfg, q, k, v,
+                  bias if bias is None else bias.astype(jnp.float32),
+                  scalars)
+
+
+def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
+                    causal: bool = False, scale: Optional[float] = None,
+                    dropout_rate: float = 0.0, seed=0,
+                    num_heads: int = 1, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Like :func:`flash_attention_with_lse` but returns only O."""
+    o, _ = flash_attention_with_lse(
+        q, k, v, bias=bias, causal=causal, scale=scale,
+        dropout_rate=dropout_rate, seed=seed, num_heads=num_heads,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+    return o

@@ -34,6 +34,7 @@ __all__ = [
     "less_than", "less_equal", "greater_than", "greater_equal", "logical_and",
     "logical_or", "logical_not", "logical_xor", "gelu", "erf", "log_softmax",
     "unstack", "resize_bilinear", "resize_nearest", "image_resize",
+    "fused_multihead_attention",
 ]
 
 
@@ -374,6 +375,28 @@ def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
     helper.append_op("matmul", inputs={"X": x, "Y": y}, outputs={"Out": out},
                      attrs={"transpose_X": transpose_x,
                             "transpose_Y": transpose_y, "alpha": alpha})
+    return out
+
+
+def fused_multihead_attention(q, k, v, bias_qk=None, causal=False,
+                              scale=0.0, attn_dropout=0.0, is_test=False,
+                              name=None):
+    """Fused multi-head attention (the reference `operators/fused/` role,
+    here a Pallas flash kernel on TPU — ops/fused_attention.py).
+
+    q/k/v: [B, num_heads, S, head_dim]; bias_qk: optional additive key bias
+    [B, S] or [B, 1, 1, S] (padding-mask encoding). Returns the same shape
+    as q. scale=0.0 means 1/sqrt(head_dim)."""
+    helper = LayerHelper("fused_multihead_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": q, "K": k, "V": v}
+    if bias_qk is not None:
+        inputs["BiasQK"] = bias_qk
+    helper.append_op("fused_multihead_attention", inputs=inputs,
+                     outputs={"Out": out},
+                     attrs={"causal": causal, "scale": scale,
+                            "attn_dropout": attn_dropout,
+                            "is_test": is_test})
     return out
 
 

@@ -41,11 +41,12 @@ class BertConfig:
 
 
 def _attention(x, mask, cfg: BertConfig, prefix: str, is_test: bool = False):
-    """Multi-head self-attention from mul/transpose/softmax primitives.
-    x: [B, S, H]; mask: [B, 1, 1, S] additive (-10000 on pads)."""
+    """Multi-head self-attention via the fused_multihead_attention op —
+    a Pallas flash kernel on TPU, softmax primitives elsewhere
+    (ops/fused_attention.py). x: [B, S, H]; mask: [B, 1, 1, S] additive
+    (-10000 on pads)."""
     B, S, H = -1, x.shape[1], cfg.hidden_size
     nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
-    init = ParamAttr(initializer=TruncatedNormal(0.0, cfg.initializer_range))
 
     def proj(name):
         return layers.fc(x, H, num_flatten_dims=2,
@@ -61,13 +62,9 @@ def _attention(x, mask, cfg: BertConfig, prefix: str, is_test: bool = False):
         return layers.transpose(t, [0, 2, 1, 3])
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    scores = layers.matmul(q, k, transpose_y=True,
-                           alpha=1.0 / math.sqrt(hd))  # [B,nh,S,S]
-    scores = layers.elementwise_add(scores, mask)
-    probs = layers.softmax(scores)
-    probs = layers.dropout(probs, cfg.attention_dropout, is_test=is_test,
-                           dropout_implementation="upscale_in_train")
-    ctxv = layers.matmul(probs, v)  # [B,nh,S,hd]
+    ctxv = layers.fused_multihead_attention(
+        q, k, v, bias_qk=mask, scale=1.0 / math.sqrt(hd),
+        attn_dropout=cfg.attention_dropout, is_test=is_test)
     ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
     ctxv = layers.reshape(ctxv, [0, S, H])
     out = layers.fc(ctxv, H, num_flatten_dims=2,

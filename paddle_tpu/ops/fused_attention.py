@@ -1,0 +1,118 @@
+"""fused_multihead_attention: the `operators/fused/` role on TPU.
+
+The reference ships hand-fused kernels where op-by-op execution leaves
+performance on the table (reference: paddle/fluid/operators/fused/
+fused_embedding_fc_lstm_op.cc, fusion_lstm_op.cc; the xbyak JIT framework
+operators/jit/kernel_base.h). On TPU the one attention-shaped fusion XLA
+cannot do itself — never materialising the [S, S] score matrix — is the
+Pallas flash-attention kernel (kernels/flash_attention.py). This op routes:
+
+- TPU backend + supported shapes -> compiled Pallas kernel (in-kernel
+  PRNG dropout, online softmax, two-kernel flash backward);
+- anything else -> an equivalent primitive composition that XLA fuses as
+  well as it can (and which serves as the numerics oracle in tests).
+
+`FLAGS_use_flash_attention` = auto|always|never picks the path explicitly;
+`always` off-TPU runs the kernel in interpret mode (slow — test use only).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .common import IOSpec, register_op, x
+from .. import flags
+
+
+def _route(sq: int, sk: int, dropout: float) -> str:
+    """'pallas' | 'pallas-interpret' | 'primitive'."""
+    from ..kernels import supports_shapes
+
+    mode = flags.flag("use_flash_attention")
+    if mode == "never":
+        return "primitive"
+    if not supports_shapes(sq, sk):
+        if mode == "always":
+            raise ValueError(
+                f"FLAGS_use_flash_attention=always but seq lengths "
+                f"({sq}, {sk}) are not divisible by the kernel blocks")
+        return "primitive"
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        return "pallas"
+    if mode == "always":
+        if dropout > 0.0:
+            # loud, not a silent primitive fallback: 'always' is a promise
+            # that the kernel runs, and the TPU PRNG the in-kernel dropout
+            # needs has no interpret-mode lowering
+            raise NotImplementedError(
+                "FLAGS_use_flash_attention=always with attn_dropout>0 "
+                "requires a TPU backend (in-kernel PRNG dropout)")
+        return "pallas-interpret"
+    return "primitive"
+
+
+def _primitive_attention(ctx, q, k, v, bias, causal, scale, dropout,
+                         is_test):
+    """[BH, S, D] oracle path; matches the kernel semantics exactly."""
+    prec = ("highest" if q.dtype == jnp.float32 else "default")
+    s = jnp.einsum("bqd,bkd->bqk", q, k, precision=prec) * scale
+    if bias is not None:
+        H = q.shape[0] // bias.shape[0]
+        s = s + jnp.repeat(bias.astype(s.dtype), H, axis=0)[:, None, :]
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        m = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(m[None], s, jnp.asarray(-1e30, s.dtype))
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout > 0.0 and not is_test:
+        keep = jax.random.bernoulli(ctx.rng(), 1.0 - dropout, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout), 0.0)
+    return jnp.einsum("bqk,bkd->bqd", p.astype(v.dtype), v, precision=prec)
+
+
+@register_op("fused_multihead_attention",
+             inputs=[IOSpec("Q"), IOSpec("K"), IOSpec("V"),
+                     IOSpec("BiasQK", optional=True, no_grad=True)],
+             outputs=["Out"],
+             attrs={"causal": False, "scale": 0.0, "attn_dropout": 0.0,
+                    "is_test": False},
+             needs_rng=True)
+def _fused_mha(ctx, ins, attrs):
+    """Q/K/V: [B, num_heads, S, head_dim]. BiasQK: additive key bias,
+    [B, S] or [B, 1, 1, S] (the models/bert.py padding-mask encoding).
+    scale 0.0 means 1/sqrt(head_dim)."""
+    q, k, v = x(ins, "Q"), x(ins, "K"), x(ins, "V")
+    bias = x(ins, "BiasQK")
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    scale = attrs["scale"] or float(D) ** -0.5
+    dropout = 0.0 if attrs.get("is_test") else float(attrs["attn_dropout"])
+    causal = bool(attrs["causal"])
+
+    if bias is not None:
+        if bias.ndim == 4:          # [B, 1, 1, S]
+            bias = bias.reshape(bias.shape[0], bias.shape[-1])
+        elif bias.ndim != 2:
+            raise ValueError(
+                f"BiasQK must be [B, S] or [B, 1, 1, S], got {bias.shape}")
+
+    q3 = q.reshape(B * H, Sq, D)
+    k3 = k.reshape(B * H, Sk, D)
+    v3 = v.reshape(B * H, Sk, D)
+    route = _route(Sq, Sk, dropout)
+    if route == "primitive":
+        o = _primitive_attention(ctx, q3, k3, v3, bias, causal, scale,
+                                 dropout, attrs.get("is_test", False))
+    else:
+        from ..kernels import flash_attention
+
+        # deterministic seed tied to this op instance: the grad op folds in
+        # the forward uid, so backward regenerates identical dropout masks
+        seed = jax.lax.convert_element_type(
+            jax.random.bits(ctx.rng(), (), jnp.uint32) >> 1, jnp.int32)
+        o = flash_attention(q3, k3, v3, bias=bias, causal=causal,
+                            scale=scale, dropout_rate=dropout, seed=seed,
+                            num_heads=H,
+                            interpret=(route == "pallas-interpret"))
+    return {"Out": [o.reshape(B, H, Sq, D)]}

@@ -51,6 +51,22 @@ def _conv_padding(padding, ksize, dilations):
     return [(p, p) for p in padding]
 
 
+def _use_nhwc() -> bool:
+    """TPU convs want channels on the 128-lane minor dim (NHWC). The API
+    stays NCHW (the reference layout); the lowering transposes at the op
+    boundary — consecutive conv/pool layers' transposes cancel in XLA, so
+    steady-state compute runs NHWC end to end. docs/PERF_NOTES.md has the
+    measured effect."""
+    from .. import flags
+
+    mode = flags.flag("conv_use_nhwc")
+    if mode == "always":
+        return True
+    if mode == "never":
+        return False
+    return jax.default_backend() == "tpu"
+
+
 @register_op("conv2d", inputs=[IOSpec("Input"), IOSpec("Filter"),
                                IOSpec("Bias", optional=True)],
              outputs=["Output"],
@@ -58,14 +74,23 @@ def _conv_padding(padding, ksize, dilations):
                     "groups": 1, "use_cudnn": True, "data_format": "NCHW"})
 def _conv2d(ctx, ins, attrs):
     inp, flt = x(ins, "Input"), x(ins, "Filter")
-    res = jax.lax.conv_general_dilated(
-        inp, flt,
-        window_strides=attrs["strides"],
-        padding=_conv_padding(attrs["paddings"], flt.shape[2:], attrs["dilations"]),
-        rhs_dilation=attrs["dilations"],
-        feature_group_count=attrs.get("groups", 1),
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-    )
+    pad = _conv_padding(attrs["paddings"], flt.shape[2:], attrs["dilations"])
+    if _use_nhwc():
+        res = jax.lax.conv_general_dilated(
+            inp.transpose(0, 2, 3, 1), flt.transpose(2, 3, 1, 0),
+            window_strides=attrs["strides"], padding=pad,
+            rhs_dilation=attrs["dilations"],
+            feature_group_count=attrs.get("groups", 1),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ).transpose(0, 3, 1, 2)
+    else:
+        res = jax.lax.conv_general_dilated(
+            inp, flt,
+            window_strides=attrs["strides"], padding=pad,
+            rhs_dilation=attrs["dilations"],
+            feature_group_count=attrs.get("groups", 1),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
     b = x(ins, "Bias")
     if b is not None:
         res = res + b.reshape((1, -1, 1, 1))
@@ -87,16 +112,34 @@ def _depthwise_conv2d(ctx, ins, attrs):
              attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
                     "groups": 1, "output_size": [], "data_format": "NCHW"})
 def _conv2d_transpose(ctx, ins, attrs):
+    """Transposed conv as an lhs-dilated forward conv with the spatially
+    flipped kernel (reference conv_transpose_op.h col2im semantics):
+    out = conv(x dilated by stride, flip(W), padding (k-1)*d - p).
+    Verified against a scatter-add oracle (tests/test_ops_nn.py).
+    Filter layout is the reference's (in, out/groups, kh, kw)."""
     inp, flt = x(ins, "Input"), x(ins, "Filter")
-    # reference filter layout for transpose conv: (in, out/groups, kh, kw)
-    res = jax.lax.conv_transpose(
-        inp, flt,
-        strides=attrs["strides"],
-        padding=[(p, p) for p in attrs["paddings"]],
-        rhs_dilation=attrs["dilations"],
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True,
-    )
+    strides = attrs["strides"]
+    dil = attrs["dilations"]
+    pads = attrs["paddings"]
+    k = flt.shape[2:]
+    pad = [((k[i] - 1) * dil[i] - pads[i],) * 2 for i in range(2)]
+    groups = attrs.get("groups", 1)
+    if groups != 1:
+        raise NotImplementedError("conv2d_transpose groups>1 not supported")
+    wf = jnp.flip(flt, (2, 3))
+    if _use_nhwc():
+        res = jax.lax.conv_general_dilated(
+            inp.transpose(0, 2, 3, 1), wf.transpose(2, 3, 0, 1),
+            window_strides=(1, 1), padding=pad,
+            lhs_dilation=strides, rhs_dilation=dil,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ).transpose(0, 3, 1, 2)
+    else:
+        res = jax.lax.conv_general_dilated(
+            inp, wf, window_strides=(1, 1), padding=pad,
+            lhs_dilation=strides, rhs_dilation=dil,
+            dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        )
     b = x(ins, "Bias")
     if b is not None:
         res = res + b.reshape((1, -1, 1, 1))
@@ -135,10 +178,18 @@ def _pool2d(ctx, ins, attrs):
             extra[i] = max(
                 0, (out_ceil - 1) * strides[i] + ksize[i]
                 - (in_hw[i] + 2 * pads[i]))
-    window = (1, 1) + tuple(ksize)
-    strd = (1, 1) + tuple(strides)
-    padding = ((0, 0), (0, 0)) + tuple(
-        (p, p + e) for p, e in zip(pads, extra))
+    nhwc = _use_nhwc()
+    if nhwc:
+        xv = xv.transpose(0, 2, 3, 1)   # keep the conv chain in NHWC
+        window = (1,) + tuple(ksize) + (1,)
+        strd = (1,) + tuple(strides) + (1,)
+        padding = ((0, 0),) + tuple(
+            (p, p + e) for p, e in zip(pads, extra)) + ((0, 0),)
+    else:
+        window = (1, 1) + tuple(ksize)
+        strd = (1, 1) + tuple(strides)
+        padding = ((0, 0), (0, 0)) + tuple(
+            (p, p + e) for p, e in zip(pads, extra))
     if attrs["pooling_type"] == "max":
         init = -jnp.inf
         res = jax.lax.reduce_window(xv, init, jax.lax.max, window, strd, padding)
@@ -151,6 +202,8 @@ def _pool2d(ctx, ins, attrs):
             res = summed / count
         else:
             res = summed / float(np.prod(ksize))
+    if nhwc:
+        res = res.transpose(0, 3, 1, 2)
     return out(res)
 
 

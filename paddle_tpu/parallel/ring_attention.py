@@ -26,11 +26,101 @@ __all__ = ["ring_attention", "ring_attention_local", "attention_reference"]
 
 
 def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
-                         scale: Optional[float] = None):
+                         scale: Optional[float] = None,
+                         use_flash: Optional[bool] = None):
     """The per-shard body — call inside shard_map over ``axis_name``.
 
     q, k, v: [B, T_local, H, D] local chunks. Returns [B, T_local, H, D].
+
+    ``use_flash`` routes the per-block attention through the Pallas flash
+    kernel (kernels/flash_attention.py) — the same kernel as
+    fused_multihead_attention — combining ring steps through each block's
+    log-sum-exp instead of carrying (m, l) explicitly. None = auto: kernel
+    on TPU when the local block shapes divide its tiles, jnp math
+    elsewhere (the CPU test mesh keeps the einsum path — Pallas interpret
+    inside shard_map is slow and PRNG-free anyway).
     """
+    if use_flash is None:
+        import jax as _jax
+
+        from ..kernels import supports_shapes
+
+        use_flash = (_jax.default_backend() == "tpu"
+                     and supports_shapes(q.shape[1], k.shape[1]))
+    if use_flash:
+        return _ring_attention_local_flash(q, k, v, axis_name, causal, scale)
+    return _ring_attention_local_jnp(q, k, v, axis_name, causal, scale)
+
+
+def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool,
+                                scale: Optional[float]):
+    """Ring body where each block product is one flash-kernel call.
+
+    Blocks combine by log-sum-exp re-weighting: for partials (o_a, lse_a)
+    and (o_b, lse_b) over disjoint key sets, lse = logaddexp and
+    o = o_a*exp(lse_a-lse) + o_b*exp(lse_b-lse). The kernel honours the
+    lse cotangent, so jax.grad through the whole ring is exact."""
+    from ..kernels import flash_attention_with_lse
+
+    B, Tl, H, D = q.shape
+    P_ = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    perm = [(i, (i + 1) % P_) for i in range(P_)]
+    # forcing the flash path on the CPU test mesh runs the kernel in the
+    # pallas interpreter (slow, tests only); compiled Mosaic on TPU
+    interpret = jax.default_backend() != "tpu"
+
+    # kernel layout is [B*H, T, D] head-major; transpose ALL of q/k/v once
+    # up front and rotate k/v around the ring already head-major (ppermute
+    # is layout-agnostic), so no per-step transpose copies
+    def to_bh(t):
+        return t.transpose(0, 2, 1, 3).reshape(B * H, Tl, D)
+
+    qh, k, v = to_bh(q), to_bh(k), to_bh(v)
+
+    def block(kb, vb, s):
+        src = (my - s) % P_                      # owner of this k/v block
+        o_s, lse_s = flash_attention_with_lse(
+            qh, kb, vb, causal=causal, scale=scale,
+            q_offset=my * Tl, k_offset=src * Tl, num_heads=H,
+            interpret=interpret)
+        return o_s, lse_s
+
+    def combine(o, lse, o_s, lse_s):
+        lse_new = jnp.logaddexp(lse, lse_s)
+        # fully-masked-so-far rows: lse == lse_new == -inf -> weight 0
+        w = jnp.where(jnp.isfinite(lse), jnp.exp(lse - lse_new), 0.0)
+        w_s = jnp.where(jnp.isfinite(lse_s), jnp.exp(lse_s - lse_new), 0.0)
+        o_new = o * w[..., None] + o_s * w_s[..., None]
+        return o_new, lse_new
+
+    o0, lse0 = block(k, v, 0)
+    kb = jax.lax.ppermute(k, axis_name, perm)
+    vb = jax.lax.ppermute(v, axis_name, perm)
+
+    def step(carry, s):
+        o, lse, kb, vb = carry
+        o_s, lse_s = block(kb, vb, s)
+        o, lse = combine(o, lse, o_s, lse_s)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (o, lse, kb, vb), None
+
+    if P_ > 2:
+        (o, lse, kb, vb), _ = jax.lax.scan(
+            step, (o0, lse0, kb, vb), jnp.arange(1, P_ - 1))
+    else:
+        o, lse = o0, lse0
+    if P_ > 1:
+        o_s, lse_s = block(kb, vb, P_ - 1)     # last block: no dead permute
+        o, lse = combine(o, lse, o_s, lse_s)
+    return o.reshape(B, H, Tl, D).transpose(0, 2, 1, 3)
+
+
+def _ring_attention_local_jnp(q, k, v, axis_name: str, causal: bool = False,
+                              scale: Optional[float] = None):
+    """Einsum ring body (runs anywhere, incl. the 8-device CPU test mesh)."""
     B, Tl, H, D = q.shape
     P_ = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -86,7 +176,8 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
 
 
 def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "sp",
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   use_flash: Optional[bool] = None):
     """shard_map wrapper: q/k/v [B, T, H, D] (global); T shards over
     ``seq_axis``, batch over 'dp' when the mesh has one."""
     try:
@@ -97,10 +188,21 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "sp",
     batch_axis = "dp" if "dp" in mesh.axis_names else None
     spec = P(batch_axis, seq_axis, None, None)
 
+    if use_flash is None:
+        from ..kernels import supports_shapes
+
+        n_sp = mesh.shape[seq_axis]
+        t_local = q.shape[1] // n_sp
+        use_flash = (jax.default_backend() == "tpu"
+                     and supports_shapes(t_local, t_local))
+    # check_vma=False on the flash path: the kernel's scalar operands
+    # (global position offsets) legitimately vary over the ring axis, which
+    # the vma checker's pallas handling rejects
     fn = shard_map(
         partial(ring_attention_local, axis_name=seq_axis, causal=causal,
-                scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                scale=scale, use_flash=use_flash),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=not use_flash)
     return fn(q, k, v)
 
 

@@ -341,3 +341,79 @@ def test_gather_grad():
 
     T().check_output()
     T().check_grad(["X"], "Out")
+
+
+def test_conv_pool_nhwc_lowering_matches_nchw():
+    """FLAGS_conv_use_nhwc=always (the TPU lowering: NHWC inner layout,
+    boundary transposes) must be numerically identical to the NCHW
+    reference lowering — conv2d, depthwise, conv2d_transpose, pool2d."""
+    import paddle_tpu as fluid
+    from paddle_tpu import flags
+
+    rng = np.random.RandomState(9)
+    xb = rng.randn(2, 8, 16, 16).astype(np.float32)
+
+    def build_and_run():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8, 16, 16], dtype="float32")
+            h = fluid.layers.conv2d(x, 16, 3, padding=1, act="relu")
+            h = fluid.layers.pool2d(h, pool_size=2, pool_type="max",
+                                    pool_stride=2)
+            h = fluid.layers.conv2d(h, 16, 3, padding=1, groups=16)
+            h = fluid.layers.conv2d_transpose(h, 8, filter_size=2, stride=2)
+            h = fluid.layers.pool2d(h, pool_size=2, pool_type="avg",
+                                    pool_stride=2)
+            loss = fluid.layers.mean(h)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            (out,) = exe.run(main, feed={"x": xb}, fetch_list=[h.name])
+        return np.asarray(out)
+
+    import paddle_tpu.unique_name as un
+
+    try:
+        flags.set_flags({"FLAGS_conv_use_nhwc": "never"})
+        with un.guard():
+            ref = build_and_run()
+        flags.set_flags({"FLAGS_conv_use_nhwc": "always"})
+        with un.guard():
+            got = build_and_run()
+    finally:
+        flags.set_flags({"FLAGS_conv_use_nhwc": "auto"})
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+
+
+class TestConv2dTranspose(OpTest):
+    """Scatter-add numpy oracle for the reference conv2d_transpose
+    semantics (filter [in, out, kh, kw], out = (H-1)*s - 2p + k).
+    Regression: the old lowering failed whenever in_ch != out_ch."""
+
+    def setup(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 6, 5, 5).astype(np.float32)
+        w = rng.randn(6, 3, 3, 3).astype(np.float32)
+        stride, pad = 2, 1
+        B, I, H, W = x.shape
+        _, O, KH, KW = w.shape
+        full = np.zeros((B, O, (H-1)*stride+KH, (W-1)*stride+KW), np.float32)
+        for b in range(B):
+            for i in range(I):
+                for h in range(H):
+                    for wi in range(W):
+                        full[b, :, h*stride:h*stride+KH,
+                             wi*stride:wi*stride+KW] += x[b, i, h, wi] * w[i]
+        out = full[:, :, pad:full.shape[2]-pad, pad:full.shape[3]-pad]
+        self.op_type = "conv2d_transpose"
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [stride, stride], "paddings": [pad, pad],
+                      "dilations": [1, 1]}
+        self.outputs = {"Output": out}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=2e-2)

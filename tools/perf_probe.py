@@ -1,0 +1,133 @@
+"""On-chip perf probes behind the round-4 MFU work (docs/PERF_NOTES.md).
+
+Each probe times a jitted computation on the real chip (compile excluded)
+and prints achieved TFLOP/s. Random inputs (constant inputs let remote
+execution caches / folding produce fantasy numbers — observed 43k TF/s).
+Run on TPU:  python tools/perf_probe.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax                      # noqa: E402
+import jax.numpy as jnp         # noqa: E402
+
+V5E_PEAK = 197.0
+RNG = np.random.RandomState(0)
+
+
+def rnd(shape, dtype=jnp.bfloat16):
+    return jnp.asarray(RNG.randn(*shape).astype(np.float32)).astype(dtype)
+
+
+def timeit(fn, *args, iters=10):
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def probe_matmul(n=4096):
+    a, b = rnd((n, n)), rnd((n, n))
+    f = jax.jit(lambda a, b: a @ b)
+    dt = timeit(f, a, b)
+    tf = 2 * n ** 3 / dt / 1e12
+    print(f"matmul {n}^3 bf16: {dt*1e3:.2f} ms, {tf:.1f} TF/s "
+          f"({100*tf/V5E_PEAK:.0f}% peak)")
+
+
+def _conv(layout, B, C_in, C_out, HW, k, stride):
+    pad = k // 2
+    if layout == "NCHW":
+        x = rnd((B, C_in, HW, HW))
+        w = rnd((C_out, C_in, k, k))
+        dn = ("NCHW", "OIHW", "NCHW")
+    else:
+        x = rnd((B, HW, HW, C_in))
+        w = rnd((k, k, C_in, C_out))
+        dn = ("NHWC", "HWIO", "NHWC")
+
+    def f(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (stride, stride), [(pad, pad), (pad, pad)],
+            dimension_numbers=dn)
+
+    out_hw = (HW + 2 * pad - k) // stride + 1
+    flops = 2 * B * out_hw * out_hw * C_out * C_in * k * k
+    return f, (x, w), flops
+
+
+def probe_conv_train(tag, B, C_in, C_out, HW, k, stride):
+    for layout in ("NCHW", "NHWC"):
+        f, (x, w), flops = _conv(layout, B, C_in, C_out, HW, k, stride)
+        g = jax.jit(jax.grad(
+            lambda x, w: jnp.sum(f(x, w).astype(jnp.float32)),
+            argnums=(0, 1)))
+        dt = timeit(g, x, w)
+        tf = 3 * flops / dt / 1e12
+        print(f"{tag} fwd+bwd {layout}: {dt*1e3:.2f} ms, ~{tf:.1f} TF/s "
+              f"({100*tf/V5E_PEAK:.0f}% peak)")
+
+
+def probe_resnet_step(nhwc: str):
+    from paddle_tpu import flags
+
+    flags.set_flags({"FLAGS_conv_use_nhwc": nhwc})
+    import paddle_tpu as fluid
+    import paddle_tpu.unique_name as un
+    from paddle_tpu.models.resnet import build_resnet
+
+    with un.guard():
+        model = build_resnet(depth=50, class_num=1000, amp=True)
+        exe = fluid.Executor(fluid.TPUPlace())
+        scope = fluid.Scope()
+        dev = fluid.TPUPlace().jax_device()
+        feed = {"img": jax.device_put(
+                    RNG.rand(128, 3, 224, 224).astype(np.float32), dev),
+                "label": jax.device_put(
+                    RNG.randint(0, 1000, (128, 1)).astype(np.int64), dev)}
+        with fluid.scope_guard(scope):
+            exe.run(model["startup"])
+
+            def step():
+                return exe.run(model["main"], feed=feed,
+                               fetch_list=[model["loss"]],
+                               return_numpy=False)
+
+            step()
+            jax.block_until_ready(list(scope.vars.values()))
+            t0 = time.perf_counter()
+            for _ in range(10):
+                out = step()
+            jax.block_until_ready(out)
+            jax.block_until_ready(list(scope.vars.values()))
+            dt = (time.perf_counter() - t0) / 10
+    tf = 128 * 3 * 4.1e9 / dt / 1e12
+    print(f"resnet50 bf16 train bs=128 [nhwc={nhwc}]: {dt*1e3:.1f} ms "
+          f"({128/dt:.0f} img/s, ~{tf:.1f} TF/s, {100*tf/V5E_PEAK:.0f}% peak)")
+    flags.set_flags({"FLAGS_conv_use_nhwc": "auto"})
+
+
+if __name__ == "__main__":
+    print("backend:", jax.default_backend())
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "micro"):
+        probe_matmul()
+        # ResNet-50 shape census: stem, early 3x3, mid 3x3, 1x1 bottleneck,
+        # strided transition, last-stage small-spatial
+        probe_conv_train("stem 7x7/2 3->64 @224", 128, 3, 64, 224, 7, 2)
+        probe_conv_train("stage1 3x3 64ch @56", 128, 64, 64, 56, 3, 1)
+        probe_conv_train("stage3 3x3 256ch @14", 128, 256, 256, 14, 3, 1)
+        probe_conv_train("1x1 256->1024 @14", 128, 256, 1024, 14, 1, 1)
+        probe_conv_train("stage4 3x3 512ch @7", 128, 512, 512, 7, 3, 1)
+    if which in ("all", "resnet"):
+        probe_resnet_step("never")
+        probe_resnet_step("always")
