@@ -22,6 +22,7 @@ from .rnn import (DynamicRNN, dynamic_lstm, dynamic_gru,  # noqa: F401
                   gru_unit, lstm, warpctc)
 from . import rnn  # noqa: F401
 from . import detection  # noqa: F401
+from .pipeline import PipelineRegion  # noqa: F401
 from . import distributions  # noqa: F401
 from .learning_rate_scheduler import (cosine_decay, exponential_decay,  # noqa: F401
                                       inverse_time_decay, linear_lr_warmup,

@@ -12,5 +12,8 @@ from . import rnn_ops  # noqa: F401
 from . import detection  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import fused_attention  # noqa: F401
+from . import pipeline_op  # noqa: F401
+from . import image  # noqa: F401
+from . import misc  # noqa: F401
 
 from ..core.registry import all_ops, get_op_def, has_op, register_op  # noqa: F401

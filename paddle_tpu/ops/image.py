@@ -1,0 +1,477 @@
+"""Image/vision ops: sampling grids, shuffles, interpolation, 3-D conv/pool.
+
+Reference kernels: paddle/fluid/operators/{grid_sampler,pixel_shuffle,
+affine_grid,affine_channel,shuffle_channel,space_to_depth,temporal_shift,
+unfold,lrn,crop,pad_constant_like,spp,conv3d,pool3d}_op.* — rebuilt on jnp
+gather/reshape/conv primitives (vectorised, no scalar loops) so XLA tiles
+them for the TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import IOSpec, out, register_op, x
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+
+@register_op("grid_sampler", inputs=["X", "Grid"], outputs=["Output"],
+             attrs={"padding_mode": "zeros", "mode": "bilinear",
+                    "align_corners": True})
+def _grid_sampler(ctx, ins, attrs):
+    """reference grid_sampler_op.h: sample X [N,C,H,W] at normalized
+    [-1,1] grid coords [N,Hg,Wg,2]. mode: bilinear|nearest; padding_mode:
+    zeros|border|reflection."""
+    xv, grid = x(ins, "X"), x(ins, "Grid")
+    N, C, H, W = xv.shape
+    mode = attrs.get("mode", "bilinear")
+    pad = attrs.get("padding_mode", "zeros")
+    if mode not in ("bilinear", "nearest") or pad not in (
+            "zeros", "border", "reflection"):
+        raise NotImplementedError(
+            f"grid_sampler mode={mode} padding_mode={pad}")
+    gx, gy = grid[..., 0], grid[..., 1]
+    if attrs.get("align_corners", True):
+        fx = (gx + 1.0) * (W - 1) / 2.0
+        fy = (gy + 1.0) * (H - 1) / 2.0
+    else:
+        fx = ((gx + 1.0) * W - 1.0) / 2.0
+        fy = ((gy + 1.0) * H - 1.0) / 2.0
+
+    def reflect(f, n):
+        # reflect about [0, n-1] with period 2(n-1) (align_corners reflect)
+        if n == 1:
+            return jnp.zeros_like(f)
+        period = 2.0 * (n - 1)
+        f = jnp.abs(jnp.mod(f, period))
+        return jnp.where(f > n - 1, period - f, f)
+
+    if pad == "reflection":
+        fx, fy = reflect(fx, W), reflect(fy, H)
+
+    def gather(yy, xx):
+        okx = (xx >= 0) & (xx <= W - 1)
+        oky = (yy >= 0) & (yy <= H - 1)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        # [N,Hg,Wg] indices into [N,C,H,W] -> [N,C,Hg,Wg]
+        v = jax.vmap(lambda img, yb, xb: img[:, yb, xb])(xv, yi, xi)
+        if pad == "zeros":
+            v = jnp.where((okx & oky)[:, None, :, :], v, 0.0)
+        # border/reflection: the clip above IS the padding rule
+        return v
+
+    if mode == "nearest":
+        return {"Output": [gather(jnp.round(fy), jnp.round(fx))]}
+
+    x0 = jnp.floor(fx)
+    y0 = jnp.floor(fy)
+    wx = fx - x0
+    wy = fy - y0
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wxb = wx[:, None]
+    wyb = wy[:, None]
+    res = (v00 * (1 - wxb) * (1 - wyb) + v01 * wxb * (1 - wyb)
+           + v10 * (1 - wxb) * wyb + v11 * wxb * wyb)
+    return {"Output": [res]}
+
+
+@register_op("affine_grid", inputs=[IOSpec("Theta"),
+                                    IOSpec("OutputShape", optional=True,
+                                           no_grad=True)],
+             outputs=["Output"],
+             attrs={"use_cudnn": True, "align_corners": True,
+                    "output_shape": []})
+def _affine_grid(ctx, ins, attrs):
+    """reference affine_grid_op.h: theta [N,2,3] -> sampling grid
+    [N,H,W,2] of normalized coords."""
+    theta = x(ins, "Theta")
+    shape = x(ins, "OutputShape")
+    if shape is not None:
+        hw = [int(v) for v in np.asarray(shape).reshape(-1)]
+    else:
+        hw = [int(v) for v in attrs["output_shape"]]
+    H, W = hw[-2], hw[-1]
+    if attrs.get("align_corners", True):
+        xs = jnp.linspace(-1.0, 1.0, W)
+        ys = jnp.linspace(-1.0, 1.0, H)
+    else:
+        xs = (jnp.arange(W) * 2 + 1) / W - 1.0
+        ys = (jnp.arange(H) * 2 + 1) / H - 1.0
+    gx, gy = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)            # [H,W,3]
+    res = jnp.einsum("hwk,nck->nhwc", base, theta)       # [N,H,W,2]
+    return {"Output": [res]}
+
+
+@register_op("pixel_shuffle", inputs=["X"], outputs=["Out"],
+             attrs={"upscale_factor": 1})
+def _pixel_shuffle(ctx, ins, attrs):
+    """reference pixel_shuffle_op.h: [N, C*r^2, H, W] -> [N, C, H*r, W*r]."""
+    xv = x(ins)
+    r = int(attrs["upscale_factor"])
+    N, C, H, W = xv.shape
+    c = C // (r * r)
+    v = xv.reshape(N, c, r, r, H, W)
+    v = v.transpose(0, 1, 4, 2, 5, 3)
+    return out(v.reshape(N, c, H * r, W * r))
+
+
+@register_op("affine_channel",
+             inputs=[IOSpec("X"), IOSpec("Scale"), IOSpec("Bias")],
+             outputs=["Out"], attrs={"data_layout": "NCHW"})
+def _affine_channel(ctx, ins, attrs):
+    xv, s, b = x(ins, "X"), x(ins, "Scale"), x(ins, "Bias")
+    if attrs.get("data_layout", "NCHW") == "NCHW":
+        shape = (1, -1) + (1,) * (xv.ndim - 2)
+    else:
+        shape = (1,) * (xv.ndim - 1) + (-1,)
+    return out(xv * s.reshape(shape) + b.reshape(shape))
+
+
+@register_op("shuffle_channel", inputs=["X"], outputs=["Out"],
+             attrs={"group": 1})
+def _shuffle_channel(ctx, ins, attrs):
+    xv = x(ins)
+    g = int(attrs["group"])
+    N, C, H, W = xv.shape
+    v = xv.reshape(N, g, C // g, H, W).swapaxes(1, 2)
+    return out(v.reshape(N, C, H, W))
+
+
+@register_op("space_to_depth", inputs=["X"], outputs=["Out"],
+             attrs={"blocksize": 1})
+def _space_to_depth(ctx, ins, attrs):
+    xv = x(ins)
+    b = int(attrs["blocksize"])
+    N, C, H, W = xv.shape
+    v = xv.reshape(N, C, H // b, b, W // b, b)
+    v = v.transpose(0, 3, 5, 1, 2, 4)
+    return out(v.reshape(N, C * b * b, H // b, W // b))
+
+
+@register_op("temporal_shift", inputs=["X"], outputs=["Out"],
+             attrs={"seg_num": 1, "shift_ratio": 0.25})
+def _temporal_shift(ctx, ins, attrs):
+    """reference temporal_shift_op.h: shift 1/4 channels fwd/back in time."""
+    xv = x(ins)
+    T = int(attrs["seg_num"])
+    ratio = float(attrs["shift_ratio"])
+    NT, C, H, W = xv.shape
+    N = NT // T
+    v = xv.reshape(N, T, C, H, W)
+    c1 = int(C * ratio)
+    c2 = int(C * 2 * ratio)
+    pad = jnp.zeros_like(v[:, :1])
+    back = jnp.concatenate([v[:, 1:, :c1], pad[:, :, :c1]], axis=1)
+    fwd = jnp.concatenate([pad[:, :, c1:c2], v[:, :-1, c1:c2]], axis=1)
+    keep = v[:, :, c2:]
+    res = jnp.concatenate([back, fwd, keep], axis=2)
+    return out(res.reshape(NT, C, H, W))
+
+
+@register_op("unfold", inputs=["X"], outputs=["Y"],
+             attrs={"kernel_sizes": [3, 3], "strides": [1, 1],
+                    "paddings": [0, 0, 0, 0], "dilations": [1, 1]})
+def _unfold(ctx, ins, attrs):
+    """reference unfold_op.h (im2col): [N,C,H,W] -> [N, C*kh*kw, L]."""
+    xv = x(ins)
+    kh, kw = attrs["kernel_sizes"]
+    sh, sw = attrs["strides"]
+    pads = attrs["paddings"]
+    dh, dw = attrs["dilations"]
+    N, C, H, W = xv.shape
+    ph0, pw0, ph1, pw1 = (pads + pads)[:4] if len(pads) == 2 else pads
+    xp = jnp.pad(xv, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    oh = (H + ph0 + ph1 - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W + pw0 + pw1 - (dw * (kw - 1) + 1)) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = jax.lax.dynamic_slice_in_dim(
+                jax.lax.dynamic_slice_in_dim(xp, i * dh, (oh - 1) * sh + 1, 2),
+                j * dw, (ow - 1) * sw + 1, 3)
+            cols.append(patch[:, :, ::sh, ::sw])
+    res = jnp.stack(cols, axis=2)                  # [N,C,kh*kw,oh,ow]
+    return {"Y": [res.reshape(N, C * kh * kw, oh * ow)]}
+
+
+@register_op("im2sequence", inputs=[IOSpec("X"),
+                                    IOSpec("Y", optional=True, no_grad=True)],
+             outputs=["Out"],
+             attrs={"kernels": [1, 1], "strides": [1, 1],
+                    "paddings": [0, 0, 0, 0], "out_stride": [1, 1]})
+def _im2sequence(ctx, ins, attrs):
+    """reference im2sequence_op.h: sliding windows as a sequence
+    [N*oh*ow, C*kh*kw] (batch-major flattened; LoD handled by the padded
+    encoding upstream)."""
+    xv = x(ins, "X")
+    kh, kw = attrs["kernels"]
+    cols = _unfold(ctx, {"X": [xv]},
+                   {"kernel_sizes": attrs["kernels"],
+                    "strides": attrs["strides"],
+                    "paddings": attrs["paddings"],
+                    "dilations": [1, 1]})["Y"][0]
+    N, CKK, L = cols.shape
+    res = cols.transpose(0, 2, 1).reshape(N * L, CKK)
+    return out(res)
+
+
+@register_op("lrn", inputs=["X"], outputs=["Out", "MidOut"],
+             attrs={"n": 5, "k": 2.0, "alpha": 1e-4, "beta": 0.75})
+def _lrn(ctx, ins, attrs):
+    """reference lrn_op.h: local response norm across channels."""
+    xv = x(ins)
+    n, k = int(attrs["n"]), attrs["k"]
+    alpha, beta = attrs["alpha"], attrs["beta"]
+    sq = xv * xv
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + xv.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": [xv / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+@register_op("crop", inputs=[IOSpec("X"), IOSpec("Y", optional=True,
+                                                 no_grad=True),
+                             IOSpec("Offsets", optional=True, no_grad=True)],
+             outputs=["Out"], attrs={"offsets": [], "shape": []})
+def _crop(ctx, ins, attrs):
+    xv = x(ins, "X")
+    yv = x(ins, "Y")
+    shape = (list(yv.shape) if yv is not None
+             else [int(s) for s in attrs["shape"]])
+    offs_in = x(ins, "Offsets")
+    offs = ([int(v) for v in np.asarray(offs_in).reshape(-1)]
+            if offs_in is not None else
+            ([int(v) for v in attrs["offsets"]] or [0] * xv.ndim))
+    idx = tuple(slice(o, o + s) for o, s in zip(offs, shape))
+    return out(xv[idx])
+
+
+@register_op("crop_tensor",
+             inputs=[IOSpec("X"),
+                     IOSpec("Shape", optional=True, no_grad=True),
+                     IOSpec("Offsets", optional=True, no_grad=True)],
+             outputs=["Out"], attrs={"offsets": [], "shape": []})
+def _crop_tensor(ctx, ins, attrs):
+    shape_in = x(ins, "Shape")
+    attrs = dict(attrs)
+    if shape_in is not None:
+        attrs["shape"] = [int(v) for v in np.asarray(shape_in).reshape(-1)]
+    return _crop(ctx, {"X": ins["X"], "Offsets": ins.get("Offsets")}, attrs)
+
+
+@register_op("pad_constant_like", inputs=[IOSpec("X", no_grad=True),
+                                          IOSpec("Y")],
+             outputs=["Out"], attrs={"pad_value": 0.0})
+def _pad_constant_like(ctx, ins, attrs):
+    xv, yv = x(ins, "X"), x(ins, "Y")
+    pads = [(0, xd - yd) for xd, yd in zip(xv.shape, yv.shape)]
+    return out(jnp.pad(yv, pads, constant_values=attrs["pad_value"]))
+
+
+@register_op("spp", inputs=["X"], outputs=["Out"],
+             attrs={"pyramid_height": 2, "pooling_type": "max"})
+def _spp(ctx, ins, attrs):
+    """reference spp_op.h: spatial pyramid pooling -> [N, C*sum(4^l)]."""
+    xv = x(ins)
+    N, C = xv.shape[:2]
+    outs = []
+    for level in range(int(attrs["pyramid_height"])):
+        bins = 2 ** level
+        H, W = xv.shape[2:]
+        # adaptive bins: region [floor(i*H/b), ceil((i+1)*H/b))
+        rows = []
+        for i in range(bins):
+            h0, h1 = (i * H) // bins, -((-(i + 1) * H) // bins)
+            for j in range(bins):
+                w0, w1 = (j * W) // bins, -((-(j + 1) * W) // bins)
+                reg = xv[:, :, h0:h1, w0:w1]
+                rows.append(reg.max(axis=(2, 3))
+                            if attrs["pooling_type"] == "max"
+                            else reg.mean(axis=(2, 3)))
+        outs.append(jnp.stack(rows, axis=-1).reshape(N, -1))
+    return out(jnp.concatenate(outs, axis=1))
+
+
+@register_op("unpool", inputs=[IOSpec("X"), IOSpec("Indices", no_grad=True)],
+             outputs=["Out"],
+             attrs={"unpooling_type": "max", "ksize": [2, 2],
+                    "strides": [2, 2], "paddings": [0, 0]})
+def _unpool(ctx, ins, attrs):
+    """reference unpool_op.h: scatter pooled values back by saved indices."""
+    xv, idx = x(ins, "X"), x(ins, "Indices")
+    N, C, H, W = xv.shape
+    oh = (H - 1) * attrs["strides"][0] - 2 * attrs["paddings"][0] + \
+        attrs["ksize"][0]
+    ow = (W - 1) * attrs["strides"][1] - 2 * attrs["paddings"][1] + \
+        attrs["ksize"][1]
+    flat = jnp.zeros((N, C, oh * ow), xv.dtype)
+    res = jax.vmap(jax.vmap(
+        lambda f, v, i: f.at[i.reshape(-1)].set(v.reshape(-1))))(
+            flat, xv, idx.astype(jnp.int32))
+    return out(res.reshape(N, C, oh, ow))
+
+
+@register_op("max_pool2d_with_index", inputs=["X"], outputs=["Out", "Mask"],
+             attrs={"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+                    "global_pooling": False, "adaptive": False})
+def _max_pool2d_with_index(ctx, ins, attrs):
+    """reference pool_with_index_op: max pool + argmax indices (flattened
+    per-channel H*W offsets, the unpool contract)."""
+    xv = x(ins)
+    N, C, H, W = xv.shape
+    kh, kw = attrs["ksize"]
+    sh, sw = attrs["strides"]
+    ph, pw = attrs["paddings"]
+    if attrs.get("global_pooling"):
+        kh, kw, sh, sw, ph, pw = H, W, H, W, 0, 0
+    oh = (H + 2 * ph - kh) // sh + 1
+    ow = (W + 2 * pw - kw) // sw + 1
+    neg = jnp.asarray(-jnp.inf, xv.dtype)
+    xp = jnp.pad(xv, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    pos = jnp.arange(H * W).reshape(H, W)
+    pos = jnp.pad(pos, ((ph, ph), (pw, pw)), constant_values=-1)
+    patches = []
+    ppos = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(xp[:, :, i:i + oh * sh:sh, j:j + ow * sw:sw])
+            ppos.append(pos[i:i + oh * sh:sh, j:j + ow * sw:sw])
+    stack = jnp.stack(patches, axis=-1)            # [N,C,oh,ow,k]
+    posst = jnp.stack(ppos, axis=-1)               # [oh,ow,k]
+    amax = jnp.argmax(stack, axis=-1)
+    res = jnp.max(stack, axis=-1)
+    idx = posst[jnp.arange(oh)[:, None], jnp.arange(ow)[None, :]][
+        None, None].repeat(N, 0).repeat(C, 1)
+    mask = jnp.take_along_axis(idx, amax[..., None], axis=-1)[..., 0]
+    return {"Out": [res], "Mask": [mask.astype(jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# 3-D conv / pool
+# ---------------------------------------------------------------------------
+
+@register_op("conv3d", inputs=[IOSpec("Input"), IOSpec("Filter"),
+                               IOSpec("Bias", optional=True)],
+             outputs=["Output"],
+             attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                    "dilations": [1, 1, 1], "groups": 1,
+                    "data_format": "NCDHW"})
+def _conv3d(ctx, ins, attrs):
+    inp, flt = x(ins, "Input"), x(ins, "Filter")
+    res = jax.lax.conv_general_dilated(
+        inp, flt, window_strides=attrs["strides"],
+        padding=[(p, p) for p in attrs["paddings"]],
+        rhs_dilation=attrs["dilations"],
+        feature_group_count=attrs.get("groups", 1),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    b = x(ins, "Bias")
+    if b is not None:
+        res = res + b.reshape((1, -1, 1, 1, 1))
+    return {"Output": [res]}
+
+
+@register_op("conv3d_transpose", inputs=[IOSpec("Input"), IOSpec("Filter"),
+                                         IOSpec("Bias", optional=True)],
+             outputs=["Output"],
+             attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                    "dilations": [1, 1, 1], "groups": 1})
+def _conv3d_transpose(ctx, ins, attrs):
+    """Same lhs-dilated formulation as conv2d_transpose (ops/nn.py)."""
+    inp, flt = x(ins, "Input"), x(ins, "Filter")
+    k = flt.shape[2:]
+    dil = attrs["dilations"]
+    pads = attrs["paddings"]
+    pad = [((k[i] - 1) * dil[i] - pads[i],) * 2 for i in range(3)]
+    wf = jnp.flip(flt, (2, 3, 4))
+    res = jax.lax.conv_general_dilated(
+        inp, wf, window_strides=(1, 1, 1), padding=pad,
+        lhs_dilation=attrs["strides"], rhs_dilation=dil,
+        dimension_numbers=("NCDHW", "IODHW", "NCDHW"))
+    b = x(ins, "Bias")
+    if b is not None:
+        res = res + b.reshape((1, -1, 1, 1, 1))
+    return {"Output": [res]}
+
+
+@register_op("pool3d", inputs=["X"], outputs=["Out"],
+             attrs={"pooling_type": "max", "ksize": [2, 2, 2],
+                    "strides": [2, 2, 2], "paddings": [0, 0, 0],
+                    "global_pooling": False, "exclusive": True,
+                    "adaptive": False, "ceil_mode": False})
+def _pool3d(ctx, ins, attrs):
+    xv = x(ins)
+    ksize = list(attrs["ksize"])
+    strides = list(attrs["strides"])
+    pads = list(attrs["paddings"])
+    if attrs.get("global_pooling"):
+        ksize = list(xv.shape[2:])
+        strides = list(ksize)
+        pads = [0, 0, 0]
+    window = (1, 1) + tuple(ksize)
+    strd = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if attrs["pooling_type"] == "max":
+        res = jax.lax.reduce_window(xv, -jnp.inf, jax.lax.max, window, strd,
+                                    padding)
+    else:
+        s = jax.lax.reduce_window(xv, 0.0, jax.lax.add, window, strd, padding)
+        if attrs.get("exclusive", True) and any(p > 0 for p in pads):
+            ones = jnp.ones_like(xv)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strd,
+                                        padding)
+            res = s / cnt
+        else:
+            res = s / float(np.prod(ksize))
+    return out(res)
+
+
+@register_op("trilinear_interp",
+             inputs=[IOSpec("X"), IOSpec("OutSize", optional=True,
+                                         no_grad=True)],
+             outputs=["Out"],
+             attrs={"out_d": -1, "out_h": -1, "out_w": -1,
+                    "align_corners": True, "align_mode": 1,
+                    "interp_method": "trilinear"})
+def _trilinear_interp(ctx, ins, attrs):
+    xv = x(ins, "X")
+    os = x(ins, "OutSize")
+    if os is not None:
+        od, oh, ow = [int(v) for v in np.asarray(os).reshape(-1)]
+    else:
+        od, oh, ow = attrs["out_d"], attrs["out_h"], attrs["out_w"]
+    N, C = xv.shape[:2]
+    if attrs.get("align_corners", True):
+        # jax.image.resize uses half-pixel centers; emulate align_corners
+        # with explicit linspace gather instead
+        def axis_idx(n_in, n_out):
+            if n_out == 1:
+                return jnp.zeros((1,))
+            return jnp.linspace(0.0, n_in - 1, n_out)
+        d = axis_idx(xv.shape[2], od)
+        h = axis_idx(xv.shape[3], oh)
+        w = axis_idx(xv.shape[4], ow)
+
+        def lin(v, idx, axis):
+            lo = jnp.floor(idx).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, v.shape[axis] - 1)
+            wgt = (idx - lo).reshape([-1 if i == axis else 1
+                                      for i in range(v.ndim)])
+            return (jnp.take(v, lo, axis) * (1 - wgt)
+                    + jnp.take(v, hi, axis) * wgt)
+
+        res = lin(lin(lin(xv, d, 2), h, 3), w, 4)
+    else:
+        res = jax.image.resize(xv, (N, C, od, oh, ow), method="trilinear")
+    return out(res)

@@ -1,0 +1,454 @@
+"""Misc dense ops: tensor utilities, norms, specialty losses, CTR helpers.
+
+Reference kernels cited per op (paddle/fluid/operators/<name>_op.{h,cc}).
+All vectorised jnp — no scalar loops — so XLA fuses them.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import IOSpec, out, register_op, x
+from .tensor import np_dtype as _np_dtype
+
+
+# ---------------------------------------------------------------------------
+# tensor utilities
+# ---------------------------------------------------------------------------
+
+@register_op("linspace", inputs=[IOSpec("Start", no_grad=True),
+                                 IOSpec("Stop", no_grad=True),
+                                 IOSpec("Num", no_grad=True)],
+             outputs=["Out"], attrs={"dtype": "float32"}, grad=None)
+def _linspace(ctx, ins, attrs):
+    start = float(np.asarray(x(ins, "Start")).reshape(-1)[0])
+    stop = float(np.asarray(x(ins, "Stop")).reshape(-1)[0])
+    num = int(np.asarray(x(ins, "Num")).reshape(-1)[0])
+    return out(jnp.linspace(start, stop, num,
+                            dtype=_np_dtype(attrs["dtype"])))
+
+
+@register_op("fill", outputs=["Out"],
+             attrs={"value": [], "shape": [], "dtype": "float32",
+                    "force_cpu": False}, grad=None)
+def _fill(ctx, ins, attrs):
+    """reference fill_op.cc: fill Out with an explicit value list."""
+    vals = jnp.asarray(attrs["value"], _np_dtype(attrs["dtype"]))
+    return out(vals.reshape([int(s) for s in attrs["shape"]]))
+
+
+@register_op("fill_any_like", inputs=[IOSpec("X", no_grad=True)],
+             outputs=["Out"], attrs={"value": 0.0, "dtype": -1}, grad=None)
+def _fill_any_like(ctx, ins, attrs):
+    xv = x(ins)
+    dt = xv.dtype if attrs.get("dtype", -1) in (-1, None) \
+        else _np_dtype(attrs["dtype"])
+    return out(jnp.full(xv.shape, attrs["value"], dt))
+
+
+@register_op("fill_zeros_like2", inputs=[IOSpec("X", no_grad=True)],
+             outputs=["Out"], attrs={"dtype": -1}, grad=None)
+def _fill_zeros_like2(ctx, ins, attrs):
+    return out(jnp.zeros_like(x(ins)))
+
+
+@register_op("multiplex", inputs=[IOSpec("Ids", no_grad=True),
+                                  IOSpec("X", duplicable=True)],
+             outputs=["Out"])
+def _multiplex(ctx, ins, attrs):
+    """reference multiplex_op.h: row r of Out = row r of X[Ids[r]]."""
+    ids = jnp.asarray(x(ins, "Ids")).reshape(-1).astype(jnp.int32)
+    stack = jnp.stack(ins["X"])                    # [K, N, ...]
+    rows = jnp.arange(stack.shape[1])
+    return out(stack[ids, rows])
+
+
+@register_op("strided_slice",
+             inputs=[IOSpec("Input"),
+                     IOSpec("StartsTensor", optional=True, no_grad=True),
+                     IOSpec("EndsTensor", optional=True, no_grad=True),
+                     IOSpec("StridesTensor", optional=True, no_grad=True)],
+             outputs=["Out"],
+             attrs={"axes": [], "starts": [], "ends": [], "strides": [],
+                    "infer_flags": [], "decrease_axis": []})
+def _strided_slice(ctx, ins, attrs):
+    xv = x(ins, "Input")
+
+    def grab(name, key):
+        t = x(ins, name)
+        return ([int(v) for v in np.asarray(t).reshape(-1)]
+                if t is not None else [int(v) for v in attrs[key]])
+
+    axes = [int(a) for a in attrs["axes"]]
+    starts = grab("StartsTensor", "starts")
+    ends = grab("EndsTensor", "ends")
+    strides = grab("StridesTensor", "strides") or [1] * len(axes)
+    idx = [slice(None)] * xv.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    res = xv[tuple(idx)]
+    for a in sorted([int(d) for d in attrs.get("decrease_axis", [])],
+                    reverse=True):
+        res = jnp.squeeze(res, a)
+    return out(res)
+
+
+@register_op("unique", inputs=[IOSpec("X", no_grad=True)],
+             outputs=["Out", "Index"], attrs={"dtype": "int32"}, grad=None)
+def _unique(ctx, ins, attrs):
+    """reference unique_op.h: first-occurrence order; Index maps each X
+    element to its position in Out. Static-shape encoding: Out is padded
+    to len(X) with the first unique value, plus '@COUNT' companioning is
+    unnecessary since Index fully determines usage."""
+    xv = jnp.asarray(x(ins)).reshape(-1)
+    n = xv.shape[0]
+    # first-occurrence rank: idx of first equal element
+    eq = xv[None, :] == xv[:, None]
+    first = jnp.argmax(eq, axis=1)                  # first index with same val
+    is_first = first == jnp.arange(n)
+    # order of appearance among firsts
+    rank = jnp.cumsum(is_first) - 1
+    # map each element to rank of its first occurrence
+    index = rank[first]
+    order = jnp.where(is_first, jnp.arange(n), n)
+    perm = jnp.argsort(order)
+    uniq = xv[perm]                                 # firsts first, pad tail
+    return {"Out": [uniq], "Index": [index.astype(_np_dtype(
+        attrs.get("dtype", "int32")))]}
+
+
+@register_op("unique_with_counts", inputs=[IOSpec("X", no_grad=True)],
+             outputs=["Out", "Index", "Count"], attrs={"dtype": "int32"},
+             grad=None)
+def _unique_with_counts(ctx, ins, attrs):
+    res = _unique(ctx, ins, attrs)
+    index = res["Index"][0]
+    n = index.shape[0]
+    count = jnp.zeros((n,), index.dtype).at[index].add(1)
+    res["Count"] = [count]
+    return res
+
+
+@register_op("size", inputs=[IOSpec("Input", no_grad=True)],
+             outputs=["Out"], grad=None)
+def _size(ctx, ins, attrs):
+    return out(jnp.asarray(int(np.prod(x(ins, "Input").shape)), jnp.int64))
+
+
+@register_op("is_empty", inputs=[IOSpec("X", no_grad=True)],
+             outputs=["Out"], grad=None)
+def _is_empty(ctx, ins, attrs):
+    return out(jnp.asarray(int(np.prod(x(ins).shape)) == 0))
+
+
+@register_op("minus", inputs=["X", "Y"], outputs=["Out"])
+def _minus(ctx, ins, attrs):
+    return out(x(ins, "X") - x(ins, "Y"))
+
+
+@register_op("random_crop", inputs=[IOSpec("X", no_grad=True),
+                                    IOSpec("Seed", optional=True,
+                                           no_grad=True)],
+             outputs=["Out", "SeedOut"], attrs={"shape": [], "startup_seed": 0},
+             grad=None, needs_rng=True)
+def _random_crop(ctx, ins, attrs):
+    """reference random_crop_op.h: crop the trailing dims to `shape` at a
+    random offset."""
+    xv = x(ins, "X")
+    shape = [int(s) for s in attrs["shape"]]
+    k = len(shape)
+    key = ctx.rng()
+    starts = []
+    for i, s in enumerate(shape):
+        limit = xv.shape[xv.ndim - k + i] - s
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, max(limit, 0) + 1))
+    begin = [0] * (xv.ndim - k) + starts
+    sizes = list(xv.shape[:xv.ndim - k]) + shape
+    res = jax.lax.dynamic_slice(xv, begin, sizes)
+    return {"Out": [res], "SeedOut": [jnp.zeros((1,), jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# norms & products
+# ---------------------------------------------------------------------------
+
+@register_op("l1_norm", inputs=["X"], outputs=["Out"])
+def _l1_norm(ctx, ins, attrs):
+    return out(jnp.sum(jnp.abs(x(ins))))
+
+
+@register_op("norm", inputs=["X"], outputs=["Out", "Norm"],
+             attrs={"axis": 1, "epsilon": 1e-10})
+def _norm(ctx, ins, attrs):
+    """reference norm_op.h: l2-normalize along axis; Norm holds the
+    denominators."""
+    xv = x(ins)
+    nrm = jnp.sqrt(jnp.sum(xv * xv, axis=attrs["axis"], keepdims=True)
+                   + attrs["epsilon"])
+    return {"Out": [xv / nrm], "Norm": [nrm]}
+
+
+@register_op("squared_l2_distance", inputs=["X", "Y"],
+             outputs=["sub_result", "Out"])
+def _squared_l2_distance(ctx, ins, attrs):
+    xv, yv = x(ins, "X"), x(ins, "Y")
+    sub = xv - yv
+    return {"sub_result": [sub],
+            "Out": [jnp.sum(sub * sub, axis=tuple(range(1, sub.ndim)),
+                            keepdims=sub.ndim > 1).reshape(xv.shape[0], 1)]}
+
+
+@register_op("bilinear_tensor_product",
+             inputs=[IOSpec("X"), IOSpec("Y"), IOSpec("Weight"),
+                     IOSpec("Bias", optional=True)],
+             outputs=["Out"])
+def _bilinear_tensor_product(ctx, ins, attrs):
+    """reference bilinear_tensor_product_op.h: out_k = x W_k y^T + b."""
+    xv, yv, w = x(ins, "X"), x(ins, "Y"), x(ins, "Weight")
+    res = jnp.einsum("bi,kij,bj->bk", xv, w, yv)
+    b = x(ins, "Bias")
+    if b is not None:
+        res = res + b.reshape(1, -1)
+    return out(res)
+
+
+@register_op("fsp", inputs=["X", "Y"], outputs=["Out"])
+def _fsp(ctx, ins, attrs):
+    """reference fsp_op.h (distillation flow matrix):
+    Out[b] = X[b] (CxHW) @ Y[b]^T (HWxC') / (H*W)."""
+    xv, yv = x(ins, "X"), x(ins, "Y")
+    B, Cx, H, W = xv.shape
+    Cy = yv.shape[1]
+    xm = xv.reshape(B, Cx, H * W)
+    ym = yv.reshape(B, Cy, H * W)
+    return out(jnp.einsum("bch,bdh->bcd", xm, ym) / (H * W))
+
+
+@register_op("add_position_encoding", inputs=["X"], outputs=["Out"],
+             attrs={"alpha": 1.0, "beta": 1.0})
+def _add_position_encoding(ctx, ins, attrs):
+    """reference add_position_encoding_op.h: sinusoid PE added to [B,S,D]."""
+    xv = x(ins)
+    B, S, D = xv.shape
+    half = D // 2
+    pos = jnp.arange(S, dtype=xv.dtype)[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype=xv.dtype) / half)
+    pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    return out(attrs["alpha"] * xv + attrs["beta"] * pe[None])
+
+
+# ---------------------------------------------------------------------------
+# specialty losses
+# ---------------------------------------------------------------------------
+
+@register_op("modified_huber_loss", inputs=[IOSpec("X"),
+                                            IOSpec("Y", no_grad=True)],
+             outputs=["IntermediateVal", "Out"])
+def _modified_huber_loss(ctx, ins, attrs):
+    """reference modified_huber_loss_op.h:40-49."""
+    xv, yv = x(ins, "X"), x(ins, "Y")
+    inter = xv * (2.0 * yv - 1.0)
+    loss = jnp.where(inter < -1.0, -4.0 * inter,
+                     jnp.where(inter < 1.0, (1.0 - inter) ** 2, 0.0))
+    return {"IntermediateVal": [inter], "Out": [loss]}
+
+
+@register_op("teacher_student_sigmoid_loss",
+             inputs=[IOSpec("X"), IOSpec("Label", no_grad=True)],
+             outputs=["Y"],
+             attrs={"soft_max_up_bound": 15.0, "soft_max_lower_bound": -15.0})
+def _teacher_student_sigmoid_loss(ctx, ins, attrs):
+    """reference teacher_student_sigmoid_loss_op.h: label encodes
+    click (z) and teacher score (z'): -2 -> no-z' noclick, -1 -> no-z'
+    click, [0,1) -> z'+0 noclick, [1,2] -> z'+1 click."""
+    xv = x(ins, "X").reshape(-1)
+    lbl = x(ins, "Label").reshape(-1).astype(xv.dtype)
+    base = jnp.maximum(xv, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(xv)))
+    ce0 = base                      # z = 0 term
+    ce1 = base - xv                 # z = 1 term
+    t0 = base - xv * lbl            # teacher term, noclick
+    t1 = base - xv * (lbl - 1.0)    # teacher term, click
+    y = jnp.where(lbl < -1.0, ce0,
+                  jnp.where(lbl < 0.0, ce1,
+                            jnp.where(lbl < 1.0, ce0 + t0, ce1 + t1)))
+    return {"Y": [y.reshape(-1, 1)]}
+
+
+@register_op("center_loss",
+             inputs=[IOSpec("X"), IOSpec("Label", no_grad=True),
+                     IOSpec("Centers", no_grad=True),
+                     IOSpec("CenterUpdateRate", no_grad=True)],
+             outputs=["CentersOut", "SampleCenterDiff", "Loss"],
+             attrs={"cluster_num": 0, "need_update": True})
+def _center_loss(ctx, ins, attrs):
+    """reference center_loss_op.h: loss = 0.5*|x - c_y|^2; centers move by
+    alpha * mean diff per class."""
+    xv = x(ins, "X")
+    lbl = jnp.asarray(x(ins, "Label")).reshape(-1).astype(jnp.int32)
+    centers = x(ins, "Centers")
+    alpha = jnp.asarray(x(ins, "CenterUpdateRate")).reshape(-1)[0]
+    diff = xv - centers[lbl]
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    if attrs.get("need_update", True):
+        acc = jnp.zeros_like(centers).at[lbl].add(diff)
+        cnt = jnp.ones((centers.shape[0],), xv.dtype).at[lbl].add(1.0)
+        new_centers = centers + alpha * acc / cnt[:, None]
+    else:
+        new_centers = centers
+    return {"CentersOut": [new_centers], "SampleCenterDiff": [diff],
+            "Loss": [loss]}
+
+
+@register_op("cvm", inputs=[IOSpec("X"), IOSpec("CVM", no_grad=True)],
+             outputs=["Y"], attrs={"use_cvm": True})
+def _cvm(ctx, ins, attrs):
+    """reference cvm_op.h:26-39: CTR show/click head columns — either
+    log-transform them (use_cvm) or strip them."""
+    xv = x(ins, "X")
+    if attrs.get("use_cvm", True):
+        c0 = jnp.log(xv[:, 0:1] + 1.0)
+        c1 = jnp.log(xv[:, 1:2] + 1.0) - c0
+        return {"Y": [jnp.concatenate([c0, c1, xv[:, 2:]], axis=1)]}
+    return {"Y": [xv[:, 2:]]}
+
+
+@register_op("data_norm", inputs=[IOSpec("X"),
+                                  IOSpec("BatchSize", no_grad=True),
+                                  IOSpec("BatchSum", no_grad=True),
+                                  IOSpec("BatchSquareSum", no_grad=True)],
+             outputs=["Y", "Means", "Scales"],
+             attrs={"epsilon": 1e-4})
+def _data_norm(ctx, ins, attrs):
+    """reference data_norm_op.cc: normalize by accumulated batch stats
+    (the CTR streaming-normalisation op)."""
+    xv = x(ins, "X")
+    n = x(ins, "BatchSize")
+    s = x(ins, "BatchSum")
+    sq = x(ins, "BatchSquareSum")
+    means = s / n
+    scales = jnp.sqrt(n / sq)
+    return {"Y": [(xv - means) * scales], "Means": [means],
+            "Scales": [scales]}
+
+
+@register_op("sampling_id", inputs=[IOSpec("X", no_grad=True)],
+             outputs=["Out"], attrs={"min": 0.0, "max": 1.0, "seed": 0},
+             grad=None, needs_rng=True)
+def _sampling_id(ctx, ins, attrs):
+    """reference sampling_id_op.h: sample column index per row of a prob
+    matrix."""
+    xv = x(ins)
+    key = (jax.random.key(attrs["seed"]) if attrs.get("seed")
+           else ctx.rng())
+    return out(jax.random.categorical(
+        key, jnp.log(jnp.maximum(xv, 1e-20)), axis=1).astype(jnp.int64))
+
+
+@register_op("similarity_focus", inputs=[IOSpec("X", no_grad=True)],
+             outputs=["Out"], attrs={"axis": 1, "indexes": []}, grad=None)
+def _similarity_focus(ctx, ins, attrs):
+    """reference similarity_focus_op.h: for each selected channel, mark the
+    (h, w) argmax rows/cols across the other spatial dims with 1."""
+    xv = x(ins)
+    N, C, H, W = xv.shape
+    res = jnp.zeros_like(xv)
+    for idx in attrs["indexes"]:
+        ch = xv[:, int(idx)]                       # [N, H, W]
+        hmax = jnp.argmax(jnp.max(ch, axis=2), axis=1)   # [N]
+        wmax = jnp.argmax(jnp.max(ch, axis=1), axis=1)   # [N]
+        rows = (jnp.arange(H)[None, :] == hmax[:, None])
+        cols = (jnp.arange(W)[None, :] == wmax[:, None])
+        mark = (rows[:, :, None] | cols[:, None, :]).astype(xv.dtype)
+        res = jnp.maximum(res, mark[:, None, :, :])
+    return out(res)
+
+
+@register_op("hash", inputs=[IOSpec("X", no_grad=True)], outputs=["Out"],
+             attrs={"num_hash": 1, "mod_by": 100000000}, grad=None)
+def _hash(ctx, ins, attrs):
+    """reference hash_op.h (xxhash of int rows): TPU-native stand-in uses a
+    multiplicative integer mix per hash seed — same contract (deterministic
+    int ids -> [num_hash] buckets), different hash family."""
+    xv = jnp.asarray(x(ins)).astype(jnp.uint32)
+    flat = xv.reshape(xv.shape[0], -1)
+    outs = []
+    for i in range(int(attrs["num_hash"])):
+        seed = jnp.uint32(0x9E3779B9 + i * 0x85EBCA6B)
+        h = jnp.full((flat.shape[0],), seed, jnp.uint32)
+        for j in range(flat.shape[1]):
+            h = (h ^ flat[:, j]) * jnp.uint32(16777619)
+        outs.append(h % jnp.uint32(attrs["mod_by"]))
+    res = jnp.stack(outs, axis=1).astype(jnp.int64)
+    return out(res.reshape(xv.shape[0], int(attrs["num_hash"]), 1))
+
+
+# ---------------------------------------------------------------------------
+# conv-ish specials
+# ---------------------------------------------------------------------------
+
+@register_op("row_conv", inputs=[IOSpec("X"), IOSpec("Filter")],
+             outputs=["Out"])
+def _row_conv(ctx, ins, attrs):
+    """reference row_conv_op.cc (lookahead conv for DeepSpeech):
+    out[t] = sum_{j<k} x[t+j] * w[j], batch-major [B, T, D]."""
+    xv, w = x(ins, "X"), x(ins, "Filter")
+    k = w.shape[0]
+    B, T, D = xv.shape
+    pad = jnp.pad(xv, ((0, 0), (0, k - 1), (0, 0)))
+    res = sum(pad[:, j:j + T] * w[j][None, None, :] for j in range(k))
+    return out(res)
+
+
+@register_op("conv_shift", inputs=["X", "Y"], outputs=["Out"])
+def _conv_shift(ctx, ins, attrs):
+    """reference conv_shift_op.cc: circular correlation of each row of X
+    [B, M] with kernel row Y [B, N]."""
+    xv, yv = x(ins, "X"), x(ins, "Y")
+    B, M = xv.shape
+    N = yv.shape[1]
+    half = (N - 1) // 2
+    idx = (jnp.arange(M)[:, None] + jnp.arange(N)[None, :] - half) % M
+    return out(jnp.einsum("bmn,bn->bm", xv[:, idx], yv))
+
+
+@register_op("label_smooth", inputs=[IOSpec("X"),
+                                     IOSpec("PriorDist", optional=True,
+                                            no_grad=True)],
+             outputs=["Out"], attrs={"epsilon": 0.0})
+def _label_smooth(ctx, ins, attrs):
+    xv = x(ins, "X")
+    eps = attrs["epsilon"]
+    prior = x(ins, "PriorDist")
+    if prior is None:
+        return out((1 - eps) * xv + eps / xv.shape[-1])
+    return out((1 - eps) * xv + eps * prior.reshape(1, -1))
+
+
+@register_op("one_hot_v2", inputs=[IOSpec("X", no_grad=True)],
+             outputs=["Out"], attrs={"depth": 1, "dtype": "float32"},
+             grad=None)
+def _one_hot_v2(ctx, ins, attrs):
+    """one_hot minus the trailing-1 requirement (2.x surface)."""
+    ids = jnp.asarray(x(ins)).astype(jnp.int32)
+    depth = int(attrs["depth"])
+    return out(jax.nn.one_hot(ids, depth,
+                              dtype=_np_dtype(attrs["dtype"])))
+
+
+@register_op("cross_entropy2", inputs=[IOSpec("X"),
+                                       IOSpec("Label", no_grad=True)],
+             outputs=["Y", "XShape", "MatchX"], attrs={"ignore_index": -100})
+def _cross_entropy2(ctx, ins, attrs):
+    """reference cross_entropy2_op: hard-label CE that also returns the
+    matched probabilities (MatchX) for the grad."""
+    xv = x(ins, "X")
+    lbl = jnp.asarray(x(ins, "Label")).astype(jnp.int32)
+    ignore = attrs.get("ignore_index", -100)
+    li = lbl.reshape(lbl.shape[:-1] + (1,)) if lbl.shape[-1:] != (1,) else lbl
+    safe = jnp.where(li == ignore, 0, li)
+    match = jnp.take_along_axis(xv, safe, axis=-1)
+    y = jnp.where(li == ignore, 0.0, -jnp.log(jnp.maximum(match, 1e-20)))
+    return {"Y": [y], "XShape": [jnp.asarray(xv.shape, jnp.int64)],
+            "MatchX": [match]}

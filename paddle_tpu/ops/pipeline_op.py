@@ -1,0 +1,112 @@
+"""`pipeline` op: Program-level pipeline parallelism over a 'pp' mesh axis.
+
+The reference cuts the program into device-placed sections streaming scopes
+through queues (reference: optimizer.py:2781 PipelineOptimizer,
+framework/trainer.h:110 PipelineTrainer, device_worker.h:267 SectionWorker).
+Here the repeated stage is a sub-block (authored once via
+layers.PipelineRegion); its parameters are [P, ...]-stacked persistable
+vars sharded over the mesh's 'pp' axis, so each rank STORES only its
+stage's slice — real placement, not annotation theater. Lowering:
+
+- mesh has a 'pp' axis of size num_stages -> parallel/pipeline.py's GPipe
+  schedule (shard_map + lax.ppermute of activations between ranks);
+- otherwise -> a lax.scan over the stacked leaves (identical math, no
+  collectives) — the single-chip / test-mesh path.
+
+The backward closes over the same function with jax.vjp (the while-op
+pattern), so reversed ppermutes pipeline the backward automatically.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .common import IOSpec, register_op
+
+EMPTY = "@EMPTY@"
+
+
+def _stage_closure(ctx, op, env):
+    """(x, stacked_tuple) -> y pure function from the sub-block."""
+    from ..lowering import lower_block
+
+    sub = ctx.program.blocks[op.attrs["sub_block"]]
+    in_name = op.attrs["in_name"]
+    out_name = op.attrs["out_name"]
+    slice_names = list(op.attrs["param_slices"])
+
+    def stage_fn(leaves, h):
+        benv = dict(env)
+        benv[in_name] = h
+        benv.update(zip(slice_names, leaves))
+        lower_block(sub, benv, ctx)
+        return benv[out_name]
+
+    return stage_fn
+
+
+def _pipeline_apply(ctx, op, env, x, stacked):
+    from ..parallel.pipeline import pipeline
+
+    P_ = int(op.attrs["num_stages"])
+    M = int(op.attrs["num_microbatches"])
+    stage_fn = _stage_closure(ctx, op, env)
+    mesh = ctx.mesh
+    if mesh is not None and "pp" in mesh.axis_names:
+        if mesh.shape["pp"] != P_:
+            raise ValueError(
+                f"pipeline op has num_stages={P_} but the mesh 'pp' axis "
+                f"has {mesh.shape['pp']} ranks — they must match (one "
+                f"stage per rank)")
+        return pipeline(lambda pl, h: stage_fn(pl, h), tuple(stacked), x,
+                        mesh, M, place_params=False)
+
+    def body(h, leaves):
+        return stage_fn(leaves, h), None
+
+    y, _ = jax.lax.scan(body, x, tuple(stacked))
+    return y
+
+
+def _pipeline_lower(ctx, op, env):
+    x = env[op.inputs["X"][0]]
+    stacked = [env[n] for n in op.inputs["StackedParams"]]
+    env[op.outputs["Out"][0]] = _pipeline_apply(ctx, op, env, x, stacked)
+
+
+def _pipeline_grad_lower(ctx, op, env):
+    """vjp through the whole schedule (the while-grad pattern); grads flow
+    to X and every stacked param."""
+    x = env[op.inputs["X"][0]]
+    stacked = [env[n] for n in op.inputs["StackedParams"]]
+
+    def fn(x_, stacked_):
+        return _pipeline_apply(ctx, op, env, x_, list(stacked_))
+
+    y, vjp_fn = jax.vjp(fn, x, tuple(stacked))
+    gy_name = op.inputs["Out@GRAD"][0]
+    gy = jnp.asarray(env[gy_name]).astype(y.dtype).reshape(y.shape)
+    gx, gstacked = vjp_fn(gy)
+    for slot, grads in (("X@GRAD", [gx]),
+                        ("StackedParams@GRAD", list(gstacked))):
+        names = op.outputs.get(slot, [])
+        for n, g in zip(names, grads):
+            if n != EMPTY:
+                env[n] = g
+
+
+def _pipeline_infer_shape(op, block):
+    xv = block._var_recursive(op.inputs["X"][0])
+    out = block._var_recursive(op.outputs["Out"][0])
+    out.shape = xv.shape
+    out.dtype = xv.dtype
+
+
+register_op("pipeline",
+            inputs=[IOSpec("X"), IOSpec("StackedParams", duplicable=True)],
+            outputs=["Out"],
+            attrs={"sub_block": None, "num_stages": 0,
+                   "num_microbatches": 1, "in_name": "", "out_name": "",
+                   "param_slices": []},
+            grad="auto", grad_lower=_pipeline_grad_lower, raw=True,
+            infer_shape=_pipeline_infer_shape)(_pipeline_lower)

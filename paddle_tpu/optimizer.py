@@ -29,6 +29,7 @@ __all__ = [
     "RMSPropOptimizer", "FtrlOptimizer", "LambOptimizer",
     "LarsMomentumOptimizer", "ExponentialMovingAverage", "ModelAverage",
     "LookaheadOptimizer", "RecomputeOptimizer", "PipelineOptimizer",
+    "GradientMergeOptimizer",
 ]
 
 
@@ -864,25 +865,21 @@ class LookaheadOptimizer:
         return ops, pgs
 
 
-class PipelineOptimizer:
-    """Pipelined (microbatched) training — reference optimizer.py:2781
-    PipelineOptimizer, which cuts the program into device-placed sections
-    run by SectionWorker threads passing scopes through queues
-    (trainer.h:110 PipelineTrainer, device_worker.h:267).
+class GradientMergeOptimizer:
+    """Microbatched gradient accumulation (reference
+    ir/multi_batch_merge_pass.cc: repeat fwd/bwd k times before one
+    update): the forward+backward ops run under a lax.scan over
+    num_microbatches slices of every feed, accumulating parameter
+    gradients; the optimizer step then runs once on the average
+    (executor.make_pipeline_step_fn). With a mean loss this is numerically
+    the plain step on the full batch — it trades peak activation memory
+    for steps."""
 
-    TPU-native collapse: the section queues become one lax.scan over
-    num_microbatches slices of the batch — forward+backward per slice with
-    gradient accumulation, one optimizer step on the averaged grads
-    (executor.make_pipeline_step_fn). Stage PLACEMENT is not per-section
-    Places but GSPMD sharding: annotate stage params over a 'pp' mesh axis
-    and XLA pipelines the collectives. ``cut_list`` is accepted for API
-    parity; cut-based placement hints are a no-op under GSPMD."""
-
-    def __init__(self, optimizer, cut_list=None, num_microbatches=2,
-                 start_cpu_core_id=0):
+    def __init__(self, optimizer, num_microbatches=2, k_steps=None,
+                 avg=True):
         self._optimizer = optimizer
-        self._cut_list = cut_list
-        self._num_microbatches = int(num_microbatches)
+        self._num_microbatches = int(k_steps or num_microbatches)
+        self._avg = bool(avg)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -891,9 +888,58 @@ class PipelineOptimizer:
         program = loss.block.program
         _, params_grads = result
         program._pipeline_microbatches = self._num_microbatches
+        program._grad_merge_avg = self._avg  # False: SUM like ref avg=False
         program._pipeline_param_grads = [(p.name, g.name)
                                          for p, g in params_grads]
         program._bump_version()
+        return result
+
+
+class PipelineOptimizer(GradientMergeOptimizer):
+    """Reference optimizer.py:2781 PipelineOptimizer: cut the program into
+    device-placed sections run by SectionWorker threads passing scopes
+    through queues (trainer.h:110 PipelineTrainer, device_worker.h:267).
+
+    TPU-native split of that job into its two halves:
+
+    - the MICROBATCH SCHEDULE (this class, via GradientMergeOptimizer):
+      fwd/bwd scan over microbatch slices with gradient accumulation —
+      numerically identical to pipelining, minus inter-stage concurrency;
+    - real STAGE PLACEMENT over a 'pp' mesh axis: author the repeated
+      stage with ``layers.PipelineRegion`` — its [num_stages, ...]-stacked
+      params shard one slice per pp rank and the `pipeline` op runs the
+      GPipe schedule with lax.ppermute'd activations
+      (ops/pipeline_op.py, parallel/pipeline.py).
+
+    ``cut_list`` names the section-boundary vars of the reference API. A
+    program whose repeated section is a PipelineRegion already carries its
+    stage structure; for a plain cut-list program the cuts are recorded on
+    the program (``_pipeline_cut_names``) and the schedule is gradient
+    accumulation — placement of heterogeneous hand-cut sections has no
+    faithful single-program GSPMD encoding."""
+
+    def __init__(self, optimizer, cut_list=None, num_microbatches=2,
+                 start_cpu_core_id=0):
+        super().__init__(optimizer, num_microbatches=num_microbatches)
+        self._cut_list = cut_list
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = super().minimize(loss, startup_program, parameter_list,
+                                  no_grad_set)
+        program = loss.block.program
+        if self._cut_list:
+            names = []
+            for cut in self._cut_list:
+                for v in (cut if isinstance(cut, (list, tuple)) else [cut]):
+                    names.append(v if isinstance(v, str) else v.name)
+            missing = [n for n in names
+                       if not program.global_block.has_var(n)]
+            if missing:
+                raise ValueError(
+                    f"PipelineOptimizer cut_list names unknown vars: "
+                    f"{missing}")
+            program._pipeline_cut_names = names
         return result
 
 

@@ -24,6 +24,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..framework import Program, Variable
+from ..executor import _shape_dtype_sig
 from ..lowering import LowerCtx, lower_block
 
 __all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy", "data_parallel_mesh"]
@@ -59,6 +60,8 @@ class ExecutionStrategy:
 
 
 def data_parallel_mesh(places=None) -> Mesh:
+    if isinstance(places, Mesh):
+        return places   # caller brought a full mesh (dp/tp/pp axes)
     devices = np.array(jax.devices() if places is None else places)
     return Mesh(devices, axis_names=("dp",))
 
@@ -125,7 +128,8 @@ class CompiledProgram:
         program = self._program
         step = self._get_compiled(exe, program, feed, fetch_names, scope)
         multiproc = jax.process_count() > 1
-        batch_shard = NamedSharding(self._mesh, P("dp"))
+        batch_shard = NamedSharding(
+            self._mesh, P("dp") if "dp" in self._mesh.axis_names else P())
         repl = NamedSharding(self._mesh, P())
         state_shardings = getattr(step, "state_shardings", {})
         if multiproc:
@@ -165,8 +169,7 @@ class CompiledProgram:
 
     def _get_compiled(self, exe, program, feed, fetch_names, scope):
         feed_sig = tuple(sorted(
-            (n, tuple(np.asarray(v).shape), str(np.asarray(v).dtype))
-            for n, v in feed.items()
+            (n,) + _shape_dtype_sig(v) for n, v in feed.items()
         ))
         from ..flags import flag
 
@@ -193,7 +196,8 @@ class CompiledProgram:
         step_fn = pick_step_fn(program)(block, io, fetch_names, mesh=mesh,
                                         nan_check_meta=nan_meta)
 
-        batch_spec = NamedSharding(mesh, P("dp"))
+        batch_spec = NamedSharding(
+            mesh, P("dp") if "dp" in mesh.axis_names else P())
         repl_spec = NamedSharding(mesh, P())
 
         # ZeRO-1 (BuildStrategy.ReduceStrategy.Reduce, ref build_strategy.h:58

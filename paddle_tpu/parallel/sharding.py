@@ -48,6 +48,12 @@ class ShardingRules:
         return self.default
 
     def sharding_for_param(self, mesh: Mesh, name: str, shape=None):
+        # pipeline-stacked params (layers.PipelineRegion) always place one
+        # stage slice per 'pp' rank — their leading dim IS the stage axis.
+        # This also covers their optimizer accumulators, whose names embed
+        # the param name.
+        if ".pp_stacked" in name and "pp" in mesh.axis_names:
+            return NamedSharding(mesh, P("pp"))
         return NamedSharding(mesh, self.spec_for_param(name, shape))
 
     def sharding_for_feed(self, mesh: Mesh):

@@ -1,0 +1,306 @@
+"""Image-op family vs numpy oracles (reference operators/{grid_sampler,
+pixel_shuffle,affine_grid,...}_op.h kernels re-derived in numpy)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+RNG = np.random.RandomState(5)
+
+
+class TestGridSampler(OpTest):
+    def setup(self):
+        x = RNG.randn(2, 3, 5, 6).astype(np.float32)
+        # sample mid-cell pixel coords (fractional part in [.25, .75]) so
+        # the finite-difference probe never crosses a floor() boundary of
+        # the piecewise-linear interpolant
+        H, W = 5, 6
+        fx = RNG.randint(0, W - 1, (2, 4, 4)) + RNG.uniform(.25, .75, (2, 4, 4))
+        fy = RNG.randint(0, H - 1, (2, 4, 4)) + RNG.uniform(.25, .75, (2, 4, 4))
+        gx = fx * 2 / (W - 1) - 1
+        gy = fy * 2 / (H - 1) - 1
+        grid = np.stack([gx, gy], axis=-1).astype(np.float32)
+        N, C, H, W = x.shape
+        out = np.zeros((2, 3, 4, 4), np.float32)
+        for n in range(2):
+            for hg in range(4):
+                for wg in range(4):
+                    gx, gy = grid[n, hg, wg]
+                    fx = (gx + 1) * (W - 1) / 2
+                    fy = (gy + 1) * (H - 1) / 2
+                    x0, y0 = int(np.floor(fx)), int(np.floor(fy))
+                    wx, wy = fx - x0, fy - y0
+                    for dy, dx, w in ((0, 0, (1-wx)*(1-wy)),
+                                      (0, 1, wx*(1-wy)),
+                                      (1, 0, (1-wx)*wy), (1, 1, wx*wy)):
+                        yy, xx = y0 + dy, x0 + dx
+                        if 0 <= yy < H and 0 <= xx < W:
+                            out[n, :, hg, wg] += w * x[n, :, yy, xx]
+        self.op_type = "grid_sampler"
+        self.inputs = {"X": x, "Grid": grid}
+        self.outputs = {"Output": out}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+        self.check_grad(["X", "Grid"], "Output", max_relative_error=3e-2)
+
+
+class TestAffineGrid(OpTest):
+    def setup(self):
+        theta = RNG.randn(2, 2, 3).astype(np.float32)
+        H, W = 3, 4
+        xs = np.linspace(-1, 1, W)
+        ys = np.linspace(-1, 1, H)
+        out = np.zeros((2, H, W, 2), np.float32)
+        for n in range(2):
+            for i in range(H):
+                for j in range(W):
+                    base = np.array([xs[j], ys[i], 1.0])
+                    out[n, i, j] = theta[n] @ base
+        self.op_type = "affine_grid"
+        self.inputs = {"Theta": theta}
+        self.attrs = {"output_shape": [2, 3, H, W]}
+        self.outputs = {"Output": out}
+
+    def test(self):
+        self.check_output(rtol=1e-5, atol=1e-5)
+        self.check_grad(["Theta"], "Output", max_relative_error=1e-2)
+
+
+class TestPixelShuffle(OpTest):
+    def setup(self):
+        x = RNG.randn(2, 8, 3, 3).astype(np.float32)
+        r = 2
+        N, C, H, W = x.shape
+        c = C // (r * r)
+        want = x.reshape(N, c, r, r, H, W).transpose(0, 1, 4, 2, 5, 3) \
+                .reshape(N, c, H * r, W * r)
+        self.op_type = "pixel_shuffle"
+        self.inputs = {"X": x}
+        self.attrs = {"upscale_factor": r}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestShuffleChannel(OpTest):
+    def setup(self):
+        x = RNG.randn(2, 6, 2, 2).astype(np.float32)
+        g = 3
+        want = x.reshape(2, g, 2, 2, 2).swapaxes(1, 2).reshape(2, 6, 2, 2)
+        self.op_type = "shuffle_channel"
+        self.inputs = {"X": x}
+        self.attrs = {"group": g}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.check_output()
+
+
+class TestSpaceToDepth(OpTest):
+    def setup(self):
+        x = RNG.randn(2, 3, 4, 4).astype(np.float32)
+        b = 2
+        want = x.reshape(2, 3, 2, b, 2, b).transpose(0, 3, 5, 1, 2, 4) \
+                .reshape(2, 12, 2, 2)
+        self.op_type = "space_to_depth"
+        self.inputs = {"X": x}
+        self.attrs = {"blocksize": b}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.check_output()
+
+
+class TestTemporalShift(OpTest):
+    def setup(self):
+        x = RNG.randn(4, 4, 2, 2).astype(np.float32)  # N=2, T=2
+        T, ratio = 2, 0.25
+        v = x.reshape(2, T, 4, 2, 2)
+        want = np.zeros_like(v)
+        c1, c2 = 1, 2
+        want[:, :-1, :c1] = v[:, 1:, :c1]
+        want[:, 1:, c1:c2] = v[:, :-1, c1:c2]
+        want[:, :, c2:] = v[:, :, c2:]
+        self.op_type = "temporal_shift"
+        self.inputs = {"X": x}
+        self.attrs = {"seg_num": T, "shift_ratio": ratio}
+        self.outputs = {"Out": want.reshape(4, 4, 2, 2)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestUnfold(OpTest):
+    def setup(self):
+        x = RNG.randn(2, 3, 5, 5).astype(np.float32)
+        kh = kw = 2
+        oh = ow = 4
+        want = np.zeros((2, 3 * kh * kw, oh * ow), np.float32)
+        for n in range(2):
+            col = 0
+            for i in range(oh):
+                for j in range(ow):
+                    want[n, :, col] = x[n, :, i:i+kh, j:j+kw].reshape(-1)
+                    col += 1
+        self.op_type = "unfold"
+        self.inputs = {"X": x}
+        self.attrs = {"kernel_sizes": [kh, kw], "strides": [1, 1],
+                      "paddings": [0, 0, 0, 0], "dilations": [1, 1]}
+        self.outputs = {"Y": want}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Y", max_relative_error=1e-2)
+
+
+class TestLRN(OpTest):
+    def setup(self):
+        x = RNG.randn(2, 6, 3, 3).astype(np.float32)
+        n, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+        sq = x * x
+        half = n // 2
+        pad = np.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+        acc = sum(pad[:, i:i + 6] for i in range(n))
+        mid = k + alpha * acc
+        self.op_type = "lrn"
+        self.inputs = {"X": x}
+        self.attrs = {"n": n, "k": k, "alpha": alpha, "beta": beta}
+        self.outputs = {"Out": x / mid ** beta, "MidOut": mid}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+
+
+class TestCropPad(OpTest):
+    def setup(self):
+        x = RNG.randn(2, 5, 5).astype(np.float32)
+        self.op_type = "crop"
+        self.inputs = {"X": x}
+        self.attrs = {"offsets": [0, 1, 2], "shape": [2, 3, 3]}
+        self.outputs = {"Out": x[:, 1:4, 2:5]}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestPadConstantLike(OpTest):
+    def setup(self):
+        x = np.zeros((3, 5), np.float32)
+        y = RNG.randn(2, 3).astype(np.float32)
+        want = np.zeros((3, 5), np.float32)
+        want[:2, :3] = y
+        self.op_type = "pad_constant_like"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["Y"], "Out")
+
+
+class TestMaxPoolWithIndexUnpool(OpTest):
+    def setup(self):
+        x = RNG.randn(1, 2, 4, 4).astype(np.float32)
+        out = np.zeros((1, 2, 2, 2), np.float32)
+        mask = np.zeros((1, 2, 2, 2), np.int32)
+        for c in range(2):
+            for i in range(2):
+                for j in range(2):
+                    blk = x[0, c, 2*i:2*i+2, 2*j:2*j+2]
+                    out[0, c, i, j] = blk.max()
+                    a = int(np.argmax(blk))
+                    mask[0, c, i, j] = (2*i + a // 2) * 4 + (2*j + a % 2)
+        self.op_type = "max_pool2d_with_index"
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+        self.outputs = {"Out": out, "Mask": mask}
+        self._unpool_args = (out, mask, x.shape)
+
+    def test(self):
+        self.check_output()
+        # unpool round-trips the pooled values to their argmax positions
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.registry import get_op_def
+        from paddle_tpu.lowering import LowerCtx
+
+        out, mask, xshape = self._unpool_args
+        res = get_op_def("unpool").lower(
+            LowerCtx(), {"X": [jnp.asarray(out)],
+                         "Indices": [jnp.asarray(mask)]},
+            {"unpooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+             "paddings": [0, 0]})["Out"][0]
+        assert res.shape == xshape
+        want = np.zeros(xshape, np.float32)
+        for c in range(2):
+            for i in range(2):
+                for j in range(2):
+                    f = mask[0, c, i, j]
+                    want[0, c, f // 4, f % 4] = out[0, c, i, j]
+        np.testing.assert_allclose(np.asarray(res), want)
+
+
+class TestConv3d(OpTest):
+    def setup(self):
+        x = RNG.randn(1, 2, 4, 4, 4).astype(np.float32)
+        w = RNG.randn(3, 2, 2, 2, 2).astype(np.float32)
+        out = np.zeros((1, 3, 3, 3, 3), np.float32)
+        for o in range(3):
+            for d in range(3):
+                for i in range(3):
+                    for j in range(3):
+                        out[0, o, d, i, j] = np.sum(
+                            x[0, :, d:d+2, i:i+2, j:j+2] * w[o])
+        self.op_type = "conv3d"
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": out}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=2e-2)
+
+
+class TestPool3d(OpTest):
+    def setup(self):
+        x = RNG.randn(1, 2, 4, 4, 4).astype(np.float32)
+        want = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+        self.op_type = "pool3d"
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2, 2],
+                      "strides": [2, 2, 2], "paddings": [0, 0, 0]}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.check_output()
+
+
+def test_affine_channel_and_spp():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_op_def
+    from paddle_tpu.lowering import LowerCtx
+
+    x = RNG.randn(2, 3, 4, 4).astype(np.float32)
+    s = RNG.randn(3).astype(np.float32)
+    b = RNG.randn(3).astype(np.float32)
+    res = get_op_def("affine_channel").lower(
+        LowerCtx(), {"X": [jnp.asarray(x)], "Scale": [jnp.asarray(s)],
+                     "Bias": [jnp.asarray(b)]}, {"data_layout": "NCHW"})
+    np.testing.assert_allclose(
+        np.asarray(res["Out"][0]),
+        x * s.reshape(1, 3, 1, 1) + b.reshape(1, 3, 1, 1), rtol=1e-6)
+
+    res = get_op_def("spp").lower(
+        LowerCtx(), {"X": [jnp.asarray(x)]},
+        {"pyramid_height": 2, "pooling_type": "max"})["Out"][0]
+    assert res.shape == (2, 3 * (1 + 4))
+    np.testing.assert_allclose(np.asarray(res)[:, :3],
+                               x.max(axis=(2, 3)), rtol=1e-6)

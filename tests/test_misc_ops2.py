@@ -1,0 +1,221 @@
+"""Round-4 misc op batch vs numpy oracles (reference kernels cited in
+paddle_tpu/ops/misc.py)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from op_test import OpTest
+from paddle_tpu.core.registry import get_op_def
+from paddle_tpu.lowering import LowerCtx
+
+RNG = np.random.RandomState(9)
+
+
+def run_op(op_type, ins, attrs=None):
+    jins = {k: [None if v is None else jnp.asarray(v) for v in vs]
+            for k, vs in ins.items()}
+    return get_op_def(op_type).lower(LowerCtx(), jins, attrs or {})
+
+
+class TestModifiedHuberLoss(OpTest):
+    def setup(self):
+        x = RNG.randn(8, 1).astype(np.float32)
+        y = RNG.randint(0, 2, (8, 1)).astype(np.float32)
+        inter = x * (2 * y - 1)
+        loss = np.where(inter < -1, -4 * inter,
+                        np.where(inter < 1, (1 - inter) ** 2, 0.0))
+        self.op_type = "modified_huber_loss"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"IntermediateVal": inter,
+                        "Out": loss.astype(np.float32)}
+
+    def test(self):
+        self.check_output(rtol=1e-5, atol=1e-6)
+
+
+class TestBilinearTensorProduct(OpTest):
+    def setup(self):
+        x = RNG.randn(3, 4).astype(np.float32)
+        y = RNG.randn(3, 5).astype(np.float32)
+        w = RNG.randn(6, 4, 5).astype(np.float32)
+        b = RNG.randn(6).astype(np.float32)
+        want = np.einsum("bi,kij,bj->bk", x, w, y) + b
+        self.op_type = "bilinear_tensor_product"
+        self.inputs = {"X": x, "Y": y, "Weight": w, "Bias": b}
+        self.outputs = {"Out": want.astype(np.float32)}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+        self.check_grad(["X", "Y", "Weight"], "Out",
+                        max_relative_error=2e-2)
+
+
+class TestNorm(OpTest):
+    def setup(self):
+        x = RNG.randn(3, 5, 2).astype(np.float32)
+        nrm = np.sqrt((x * x).sum(1, keepdims=True) + 1e-10)
+        self.op_type = "norm"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "epsilon": 1e-10}
+        self.outputs = {"Out": x / nrm, "Norm": nrm}
+
+    def test(self):
+        self.check_output(rtol=1e-5, atol=1e-6)
+        self.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+class TestRowConv(OpTest):
+    def setup(self):
+        x = RNG.randn(2, 6, 3).astype(np.float32)
+        w = RNG.randn(3, 3).astype(np.float32)  # k=3 lookahead
+        want = np.zeros_like(x)
+        for t in range(6):
+            for j in range(3):
+                if t + j < 6:
+                    want[:, t] += x[:, t + j] * w[j]
+        self.op_type = "row_conv"
+        self.inputs = {"X": x, "Filter": w}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.check_output(rtol=1e-5, atol=1e-6)
+        self.check_grad(["X", "Filter"], "Out", max_relative_error=2e-2)
+
+
+def test_unique_and_counts():
+    x = np.array([2, 3, 3, 1, 5, 2, 2], np.int64)
+    res = run_op("unique_with_counts", {"X": [x]})
+    uniq = np.asarray(res["Out"][0])
+    idx = np.asarray(res["Index"][0])
+    cnt = np.asarray(res["Count"][0])
+    # first-occurrence order: 2, 3, 1, 5
+    np.testing.assert_array_equal(uniq[:4], [2, 3, 1, 5])
+    np.testing.assert_array_equal(uniq[idx], x)
+    np.testing.assert_array_equal(cnt[:4], [3, 2, 1, 1])
+
+
+def test_multiplex_strided_slice_linspace_fill():
+    xs = [RNG.randn(4, 3).astype(np.float32) for _ in range(3)]
+    ids = np.array([[2], [0], [1], [2]], np.int64)
+    res = run_op("multiplex", {"Ids": [ids], "X": xs})["Out"][0]
+    want = np.stack([xs[2][0], xs[0][1], xs[1][2], xs[2][3]])
+    np.testing.assert_allclose(np.asarray(res), want)
+
+    x = RNG.randn(4, 8).astype(np.float32)
+    res = run_op("strided_slice", {"Input": [x]},
+                 {"axes": [1], "starts": [1], "ends": [7], "strides": [2],
+                  "infer_flags": [], "decrease_axis": []})["Out"][0]
+    np.testing.assert_allclose(np.asarray(res), x[:, 1:7:2])
+
+    res = run_op("linspace", {"Start": [np.float32(0)],
+                              "Stop": [np.float32(1)],
+                              "Num": [np.int32(5)]},
+                 {"dtype": "float32"})["Out"][0]
+    np.testing.assert_allclose(np.asarray(res), np.linspace(0, 1, 5))
+
+    res = run_op("fill", {}, {"value": [1.0, 2.0, 3.0, 4.0],
+                              "shape": [2, 2], "dtype": "float32"})["Out"][0]
+    np.testing.assert_allclose(np.asarray(res), [[1, 2], [3, 4]])
+
+
+def test_teacher_student_and_cvm_and_center_loss():
+    x = RNG.randn(6).astype(np.float32)
+    lbl = np.array([-2.0, -1.0, 0.3, 1.7, -2.0, 0.9], np.float32)
+    res = np.asarray(run_op(
+        "teacher_student_sigmoid_loss",
+        {"X": [x.reshape(-1, 1)], "Label": [lbl.reshape(-1, 1)]},
+        {})["Y"][0]).reshape(-1)
+    base = np.maximum(x, 0) + np.log1p(np.exp(-np.abs(x)))
+    want = np.where(lbl < -1, base,
+                    np.where(lbl < 0, base - x,
+                             np.where(lbl < 1, 2 * base - x * lbl,
+                                      2 * base - x - x * (lbl - 1))))
+    np.testing.assert_allclose(res, want, rtol=1e-5)
+
+    xc = np.abs(RNG.randn(3, 6)).astype(np.float32)
+    y = np.asarray(run_op("cvm", {"X": [xc], "CVM": [np.ones((3, 2),
+                                                             np.float32)]},
+                          {"use_cvm": True})["Y"][0])
+    np.testing.assert_allclose(y[:, 0], np.log(xc[:, 0] + 1), rtol=1e-5)
+    np.testing.assert_allclose(y[:, 1],
+                               np.log(xc[:, 1] + 1) - np.log(xc[:, 0] + 1),
+                               rtol=1e-4, atol=1e-6)
+    y2 = np.asarray(run_op("cvm", {"X": [xc], "CVM": [np.ones((3, 2),
+                                                              np.float32)]},
+                           {"use_cvm": False})["Y"][0])
+    np.testing.assert_allclose(y2, xc[:, 2:])
+
+    feat = RNG.randn(5, 4).astype(np.float32)
+    labels = np.array([0, 1, 0, 2, 1], np.int64)
+    centers = RNG.randn(3, 4).astype(np.float32)
+    res = run_op("center_loss",
+                 {"X": [feat], "Label": [labels], "Centers": [centers],
+                  "CenterUpdateRate": [np.float32([0.5])]},
+                 {"cluster_num": 3, "need_update": True})
+    diff = feat - centers[labels]
+    np.testing.assert_allclose(np.asarray(res["SampleCenterDiff"][0]), diff,
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(res["Loss"][0]).reshape(-1),
+        0.5 * (diff ** 2).sum(1), rtol=1e-5)
+
+
+def test_add_position_encoding_and_conv_shift():
+    x = RNG.randn(2, 4, 6).astype(np.float32)
+    res = np.asarray(run_op("add_position_encoding", {"X": [x]},
+                            {"alpha": 1.0, "beta": 1.0})["Out"][0])
+    half = 3
+    pos = np.arange(4)[:, None]
+    div = 10000.0 ** (np.arange(half) / half)
+    pe = np.concatenate([np.sin(pos / div), np.cos(pos / div)], 1)
+    np.testing.assert_allclose(res, x + pe[None], rtol=1e-4, atol=1e-5)
+
+    xm = RNG.randn(2, 5).astype(np.float32)
+    ym = RNG.randn(2, 3).astype(np.float32)
+    res = np.asarray(run_op("conv_shift", {"X": [xm], "Y": [ym]})["Out"][0])
+    want = np.zeros_like(xm)
+    for b in range(2):
+        for i in range(5):
+            for j in range(3):
+                want[b, i] += xm[b, (i + j - 1) % 5] * ym[b, j]
+    np.testing.assert_allclose(res, want, rtol=1e-5)
+
+
+def test_label_smooth_one_hot_v2_cross_entropy2():
+    x = np.eye(4, dtype=np.float32)[None].repeat(2, 0).reshape(8, 4)
+    res = np.asarray(run_op("label_smooth", {"X": [x]},
+                            {"epsilon": 0.1})["Out"][0])
+    np.testing.assert_allclose(res, 0.9 * x + 0.1 / 4, rtol=1e-6)
+
+    ids = np.array([[0, 2], [3, 1]], np.int64)
+    res = np.asarray(run_op("one_hot_v2", {"X": [ids]},
+                            {"depth": 4, "dtype": "float32"})["Out"][0])
+    assert res.shape == (2, 2, 4)
+    assert res[0, 1, 2] == 1.0 and res[1, 0, 3] == 1.0
+
+    probs = np.abs(RNG.rand(5, 4)).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    lbl = RNG.randint(0, 4, (5, 1)).astype(np.int64)
+    res = run_op("cross_entropy2", {"X": [probs], "Label": [lbl]},
+                 {"ignore_index": -100})
+    want = -np.log(np.take_along_axis(probs, lbl, 1))
+    np.testing.assert_allclose(np.asarray(res["Y"][0]), want, rtol=1e-5)
+
+
+def test_fsp_and_squared_l2_distance_and_minus():
+    x = RNG.randn(2, 3, 4, 4).astype(np.float32)
+    y = RNG.randn(2, 5, 4, 4).astype(np.float32)
+    res = np.asarray(run_op("fsp", {"X": [x], "Y": [y]})["Out"][0])
+    want = np.einsum("bch,bdh->bcd", x.reshape(2, 3, 16),
+                     y.reshape(2, 5, 16)) / 16
+    np.testing.assert_allclose(res, want, rtol=1e-4, atol=1e-5)
+
+    a = RNG.randn(4, 3).astype(np.float32)
+    b = RNG.randn(4, 3).astype(np.float32)
+    res = run_op("squared_l2_distance", {"X": [a], "Y": [b]})
+    np.testing.assert_allclose(np.asarray(res["Out"][0]).reshape(-1),
+                               ((a - b) ** 2).sum(1), rtol=1e-5)
+    res = np.asarray(run_op("minus", {"X": [a], "Y": [b]})["Out"][0])
+    np.testing.assert_allclose(res, a - b)

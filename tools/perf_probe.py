@@ -1,13 +1,20 @@
 """On-chip perf probes behind the round-4 MFU work (docs/PERF_NOTES.md).
 
-Each probe times a jitted computation on the real chip (compile excluded)
-and prints achieved TFLOP/s. Random inputs (constant inputs let remote
-execution caches / folding produce fantasy numbers — observed 43k TF/s).
-Run on TPU:  python tools/perf_probe.py
+Measurement protocol for the axon dev tunnel (hard-won, do not "simplify"):
+- timing must run over a DATA-DEPENDENT chain of iterations (carry the
+  output into the next step). Independent dispatches complete out of order
+  behind the tunnel; blocking on the last one does NOT drain the others —
+  that both fakes the timed section (>1000% "peak" observed) and leaves a
+  backlog that poisons whatever is timed next.
+- finish with a host fetch (float(...)) — the only hard sync point.
+- subtract the ~70-100 ms round-trip by differencing two chain lengths.
+
+Run on TPU:  python tools/perf_probe.py [micro|resnet|all]
 """
 from __future__ import annotations
 
 import os
+import statistics
 import sys
 import time
 
@@ -23,61 +30,80 @@ RNG = np.random.RandomState(0)
 
 
 def rnd(shape, dtype=jnp.bfloat16):
-    return jnp.asarray(RNG.randn(*shape).astype(np.float32)).astype(dtype)
+    return jax.device_put(RNG.randn(*shape).astype(np.float32)).astype(dtype)
 
 
-def timeit(fn, *args, iters=10):
-    jax.block_until_ready(fn(*args))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+def chain_time(make_fn, k_short=4, k_long=16, iters=3):
+    """Median per-iteration seconds of make_fn(k)'s chained body, RTT
+    removed by (T_long - T_short) / (k_long - k_short)."""
+    def run(k):
+        f = make_fn(k)
+        float(f())            # compile + warm
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            float(f())
+            ts.append(time.perf_counter() - t0)
+        return statistics.median(ts)
+
+    return (run(k_long) - run(k_short)) / (k_long - k_short)
 
 
 def probe_matmul(n=4096):
     a, b = rnd((n, n)), rnd((n, n))
-    f = jax.jit(lambda a, b: a @ b)
-    dt = timeit(f, a, b)
+
+    def make(k):
+        @jax.jit
+        def f():
+            x = a
+            for _ in range(k):
+                x = x @ b * (1.0 / n)
+            return x.astype(jnp.float32).sum()
+        return f
+
+    dt = chain_time(make, 20, 200)
     tf = 2 * n ** 3 / dt / 1e12
-    print(f"matmul {n}^3 bf16: {dt*1e3:.2f} ms, {tf:.1f} TF/s "
+    print(f"matmul {n}^3 bf16: {dt*1e3:.3f} ms, {tf:.1f} TF/s "
           f"({100*tf/V5E_PEAK:.0f}% peak)")
 
 
-def _conv(layout, B, C_in, C_out, HW, k, stride):
+def probe_conv_train(tag, B, C, HW, k, layout):
+    """fwd+bwd of one CxC kxk conv at BxHWxHW, chained through a dummy
+    SGD update so iterations serialize."""
     pad = k // 2
     if layout == "NCHW":
-        x = rnd((B, C_in, HW, HW))
-        w = rnd((C_out, C_in, k, k))
+        x = rnd((B, C, HW, HW))
+        w0 = rnd((C, C, k, k), jnp.float32)
         dn = ("NCHW", "OIHW", "NCHW")
     else:
-        x = rnd((B, HW, HW, C_in))
-        w = rnd((k, k, C_in, C_out))
+        x = rnd((B, HW, HW, C))
+        w0 = rnd((k, k, C, C), jnp.float32)
         dn = ("NHWC", "HWIO", "NHWC")
 
-    def f(x, w):
-        return jax.lax.conv_general_dilated(
-            x, w, (stride, stride), [(pad, pad), (pad, pad)],
+    def loss(w):
+        y = jax.lax.conv_general_dilated(
+            x, w.astype(jnp.bfloat16), (1, 1), [(pad, pad), (pad, pad)],
             dimension_numbers=dn)
+        return jnp.sum(y.astype(jnp.float32) ** 2) * 1e-12
 
-    out_hw = (HW + 2 * pad - k) // stride + 1
-    flops = 2 * B * out_hw * out_hw * C_out * C_in * k * k
-    return f, (x, w), flops
+    def make(kk):
+        @jax.jit
+        def f():
+            def body(w, _):
+                g = jax.grad(loss)(w)
+                return w - 1e-20 * g, None
+            w, _ = jax.lax.scan(body, w0, None, length=kk)
+            return w.sum()
+        return f
+
+    dt = chain_time(make, 2, 10)
+    flops = 3 * 2 * B * HW * HW * C * C * k * k
+    tf = flops / dt / 1e12
+    print(f"{tag} fwd+bwd {layout}: {dt*1e3:.2f} ms, ~{tf:.1f} TF/s "
+          f"({100*tf/V5E_PEAK:.0f}% peak)")
 
 
-def probe_conv_train(tag, B, C_in, C_out, HW, k, stride):
-    for layout in ("NCHW", "NHWC"):
-        f, (x, w), flops = _conv(layout, B, C_in, C_out, HW, k, stride)
-        g = jax.jit(jax.grad(
-            lambda x, w: jnp.sum(f(x, w).astype(jnp.float32)),
-            argnums=(0, 1)))
-        dt = timeit(g, x, w)
-        tf = 3 * flops / dt / 1e12
-        print(f"{tag} fwd+bwd {layout}: {dt*1e3:.2f} ms, ~{tf:.1f} TF/s "
-              f"({100*tf/V5E_PEAK:.0f}% peak)")
-
-
-def probe_resnet_step(nhwc: str):
+def probe_resnet_step(nhwc: str, iters=10):
     from paddle_tpu import flags
 
     flags.set_flags({"FLAGS_conv_use_nhwc": nhwc})
@@ -102,14 +128,14 @@ def probe_resnet_step(nhwc: str):
                                fetch_list=[model["loss"]],
                                return_numpy=False)
 
-            step()
-            jax.block_until_ready(list(scope.vars.values()))
+            # warm + hard sync (host fetch) so timing starts quiescent
+            out = step()
+            float(np.asarray(out[0]).reshape(-1)[0])
             t0 = time.perf_counter()
-            for _ in range(10):
-                out = step()
-            jax.block_until_ready(out)
-            jax.block_until_ready(list(scope.vars.values()))
-            dt = (time.perf_counter() - t0) / 10
+            for _ in range(iters):
+                out = step()   # state donation chains the iterations
+            float(np.asarray(out[0]).reshape(-1)[0])
+            dt = (time.perf_counter() - t0) / iters
     tf = 128 * 3 * 4.1e9 / dt / 1e12
     print(f"resnet50 bf16 train bs=128 [nhwc={nhwc}]: {dt*1e3:.1f} ms "
           f"({128/dt:.0f} img/s, ~{tf:.1f} TF/s, {100*tf/V5E_PEAK:.0f}% peak)")
@@ -121,13 +147,10 @@ if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if which in ("all", "micro"):
         probe_matmul()
-        # ResNet-50 shape census: stem, early 3x3, mid 3x3, 1x1 bottleneck,
-        # strided transition, last-stage small-spatial
-        probe_conv_train("stem 7x7/2 3->64 @224", 128, 3, 64, 224, 7, 2)
-        probe_conv_train("stage1 3x3 64ch @56", 128, 64, 64, 56, 3, 1)
-        probe_conv_train("stage3 3x3 256ch @14", 128, 256, 256, 14, 3, 1)
-        probe_conv_train("1x1 256->1024 @14", 128, 256, 1024, 14, 1, 1)
-        probe_conv_train("stage4 3x3 512ch @7", 128, 512, 512, 7, 3, 1)
+        for layout in ("NCHW", "NHWC"):
+            probe_conv_train("stage1 3x3 64ch @56", 128, 64, 56, 3, layout)
+            probe_conv_train("stage3 3x3 256ch @14", 128, 256, 14, 3, layout)
+            probe_conv_train("stage4 3x3 512ch @7", 128, 512, 7, 3, layout)
     if which in ("all", "resnet"):
         probe_resnet_step("never")
         probe_resnet_step("always")
