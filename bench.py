@@ -117,7 +117,7 @@ def bench_resnet_infer(amp: bool, batch=128, iters=20):
     return dt * 1e3  # ms/batch
 
 
-def bench_bert_train(batch=32, seq_len=128, iters=10):
+def bench_bert_train(batch=64, seq_len=512, iters=10):
     import jax
 
     import paddle_tpu as fluid
@@ -151,8 +151,12 @@ def bench_bert_train(batch=32, seq_len=128, iters=10):
                             fetch_list=[model["loss"]], return_numpy=False),
             warmup=2, iters=iters, scope=scope)
     steps_per_s = 1.0 / dt
-    tflops = 6 * n_params * batch * seq_len * steps_per_s / 1e12
-    return steps_per_s, tflops
+    # 6ND for the matmul path plus the attention-score term (QK^T + PV are
+    # 4*B*S^2*hidden FLOPs/layer fwd, x3 with backward) which 6ND omits and
+    # which is no longer negligible at seq 512.
+    attn_flops = 3 * 4 * batch * seq_len**2 * cfg.hidden_size * cfg.num_layers
+    tflops = (6 * n_params * batch * seq_len + attn_flops) * steps_per_s / 1e12
+    return steps_per_s, tflops, batch, seq_len
 
 
 def main():
@@ -190,12 +194,12 @@ def main():
         extra["resnet50_infer_bs128_bf16_ms"] = round(infer_bf16_ms, 2)
         extra["ref_v100_fp16_infer_bs128_ms"] = REF_FP16_INFER_MS
     if bert is not None:
-        bert_steps, bert_tflops = bert
+        bert_steps, bert_tflops, bert_bs, bert_sl = bert
         extra["bert_base_train_bf16_steps_per_s"] = round(bert_steps, 2)
         extra["bert_base_train_bf16_tflops"] = round(bert_tflops, 1)
         extra["bert_base_train_mfu_vs_v5e_peak"] = round(
             bert_tflops / V5E_BF16_PEAK_TFLOPS, 3)
-        extra["bert_batch"], extra["bert_seq_len"] = 32, 128
+        extra["bert_batch"], extra["bert_seq_len"] = bert_bs, bert_sl
 
     print(json.dumps({
         "metric": "resnet50_train_bf16_img_per_s",

@@ -1,0 +1,31 @@
+"""Communicator compat surface (reference communicator.py:26, wrapping the
+C++ async-SGD Communicator, communicator.h:163).
+
+The reference Communicator ran background send/recv threads merging
+gradients for *async* pserver training. Sync training never needed it, and
+async training is intentionally unsupported on TPU (see
+transpiler.distribute_transpiler). Constructing one therefore raises with
+the migration message — the importable class IS the decision surface a
+2019 script hits, instead of an ImportError.
+"""
+from __future__ import annotations
+
+__all__ = ["Communicator"]
+
+
+class Communicator:
+    def __init__(self, program, vars_info=None, trainers=None,
+                 geo_sgd_need_push_nums=None):
+        raise NotImplementedError(
+            "Communicator drove ASYNC parameter-server training "
+            "(communicator.h:163); async consistency has no TPU analogue. "
+            "Sync collective training needs no communicator — gradients "
+            "are exchanged by XLA collectives compiled into the step. See "
+            "fluid.transpiler.DistributeTranspiler (sync mode) or "
+            "fleet.distributed_optimizer.")
+
+    def start(self):  # pragma: no cover - unreachable after __init__ raises
+        pass
+
+    def stop(self):  # pragma: no cover
+        pass

@@ -381,6 +381,12 @@ class Executor:
         if isinstance(program, CompiledProgram):
             return program._run(self, feed, fetch_list, scope, return_numpy)
 
+        # pserver-role program from the DistributeTranspiler shim: nothing
+        # to serve on TPU (params live on-chip), return immediately so 2019
+        # PS launch scripts complete cleanly
+        if getattr(program, "_is_pserver_noop", False):
+            return []
+
         program = program or default_main_program()
         scope = scope or global_scope()
         feed = feed or {}

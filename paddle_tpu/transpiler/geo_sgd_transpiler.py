@@ -1,0 +1,23 @@
+"""GEO-SGD transpiler compat surface (reference
+transpiler/geo_sgd_transpiler.py, communicator.h:320 GeoSgdCommunicator).
+
+GEO-SGD shipped parameter *deltas* every k steps between trainers and
+pservers with no global barrier — an asynchronous consistency model built
+for slow networks. ICI makes the premise obsolete and the semantics
+unreproducible (there is no pserver to absorb the races), so this class
+raises at construction with the supported migration: LocalSGD, which has
+the same k-step communication cadence with well-defined averaging.
+"""
+from __future__ import annotations
+
+__all__ = ["GeoSgdTranspiler"]
+
+
+class GeoSgdTranspiler:
+    def __init__(self, config=None):
+        raise NotImplementedError(
+            "GEO-SGD is intentionally unsupported on TPU (async pserver "
+            "consistency has no ICI analogue). Migrate to LocalSGD: "
+            "fleet.DistributedStrategy(use_local_sgd=True) gives the same "
+            "k-step communication cadence with defined averaging "
+            "semantics.")

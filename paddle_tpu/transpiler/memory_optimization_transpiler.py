@@ -1,0 +1,34 @@
+"""Legacy memory-optimize transpiler (reference
+transpiler/memory_optimization_transpiler.py:18 memory_optimize, :42
+release_memory).
+
+On TPU these are no-ops by design, not omission: buffer liveness, reuse,
+and in-place rewriting are owned by XLA buffer assignment (the reference's
+own 1.6 release already deprecated this pass in favor of compile-time
+analysis). The functions stay importable so 2019 scripts run unchanged;
+they validate arguments and return the program untouched.
+"""
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["memory_optimize", "release_memory"]
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    if level not in (0, 1):
+        raise ValueError("level must be 0 or 1")
+    warnings.warn(
+        "memory_optimize is a no-op on TPU: XLA buffer assignment performs "
+        "liveness-based reuse and in-placing at compile time "
+        "(reference deprecated this pass for the same reason).",
+        stacklevel=2)
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    warnings.warn(
+        "release_memory is a no-op on TPU: intermediate buffers are freed "
+        "by XLA's buffer assignment, not graph rewriting.", stacklevel=2)
+    return input_program
