@@ -31,6 +31,9 @@ from .dataset_feed import DatasetFactory
 from .reader import DataLoader, PyReader, batch
 from . import metrics
 from . import optimizer
+from . import transpiler
+from .transpiler import (DistributeTranspiler, DistributeTranspilerConfig,
+                         memory_optimize, release_memory)
 from . import profiler
 from . import regularizer
 from .core import registry as op_registry

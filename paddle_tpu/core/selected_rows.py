@@ -1,0 +1,99 @@
+"""SelectedRows — sparse row-slice gradients, the TPU way.
+
+Reference role: paddle/fluid/framework/selected_rows.h:32 (a {rows, value,
+height} triple used as the gradient type of ``is_sparse`` embedding lookups)
+plus the sparse branches of the optimizer kernels
+(operators/optimizers/adam_op.h SparseAdamFunctor, sgd_op.h, momentum).
+
+TPU-first design: XLA needs static shapes, so the rows vector is fixed at
+``N = number of lookups this step`` (batch x seq), NOT the dynamic number of
+unique ids. ``merge_rows`` canonicalizes at creation time — sort + segment
+sum — so every downstream consumer sees duplicate-free rows, with unused
+trailing slots holding the out-of-bounds sentinel ``height`` that XLA
+scatter's mode="drop" discards. Memory/compute per step is O(N x dim), not
+O(vocab x dim): exactly the property the reference's SelectedRows bought on
+parameter servers, delivered here via gather/scatter + segment ops that XLA
+lowers to efficient TPU sort/scan kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """rows: int32[N] (duplicate-free, sentinel-padded with ``height``),
+    values: float[N, ...tail], height: static int (table row count)."""
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        return cls(children[0], children[1], height)
+
+    # NOTE: deliberately no ``.dtype``/``.shape`` attributes — the executor's
+    # nan-check walk and feed signature logic treat anything with those as a
+    # dense array.
+
+    def astype(self, dtype) -> "SelectedRows":
+        return SelectedRows(self.rows, self.values.astype(dtype), self.height)
+
+    def scale(self, s) -> "SelectedRows":
+        return SelectedRows(self.rows, self.values * s, self.height)
+
+    def to_dense(self):
+        """Materialize the dense [height, ...] gradient (fallback for
+        consumers without a sparse path). Sentinel rows are dropped."""
+        z = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                      self.values.dtype)
+        return z.at[self.rows].add(self.values, mode="drop")
+
+    def __repr__(self):
+        return (f"SelectedRows(n={self.rows.shape[0]}, "
+                f"height={self.height}, tail={self.values.shape[1:]})")
+
+
+def is_selected_rows(v) -> bool:
+    return isinstance(v, SelectedRows)
+
+
+def merge_rows(ids, values, height: int) -> SelectedRows:
+    """Canonical SelectedRows from raw (possibly duplicated) lookup ids and
+    per-lookup gradient rows: sort ids, segment-sum duplicate rows, pad the
+    tail with the ``height`` sentinel. The reference does this merge in
+    operators/math/selected_rows_functor.cc MergeAdd; here it is three XLA
+    ops (sort, scan for segment ids, two segment reductions)."""
+    n = ids.shape[0]
+    ids = ids.astype(jnp.int32)
+    order = jnp.argsort(ids)
+    sids = ids[order]
+    svals = values[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sids[1:] != sids[:-1]])
+    seg = jnp.cumsum(first) - 1                       # [n] segment index
+    summed = jax.ops.segment_sum(svals, seg, num_segments=n)
+    rows = jax.ops.segment_min(sids, seg, num_segments=n)
+    n_unique = seg[-1] + 1
+    valid = jnp.arange(n) < n_unique
+    rows = jnp.where(valid, rows, height)             # sentinel -> dropped
+    # zero sentinel slots' values too: ids pre-routed to ``height`` (e.g.
+    # padding_idx) summed real cotangents there, and norm/clip consumers
+    # reduce over values — a dropped-at-scatter row must also read as zero
+    live = (rows < height).reshape((n,) + (1,) * (values.ndim - 1))
+    summed = jnp.where(live, summed, 0)
+    return SelectedRows(rows.astype(jnp.int32), summed, height)
+
+
+def concat_merge(a: SelectedRows, b: SelectedRows) -> SelectedRows:
+    """Sum of two SelectedRows (shared-table multi-consumer grads): concat
+    then re-merge. Sentinel rows sort to the end and stay sentinels."""
+    assert a.height == b.height, "summing grads of different tables"
+    return merge_rows(jnp.concatenate([a.rows, b.rows]),
+                      jnp.concatenate([a.values, b.values]), a.height)

@@ -427,6 +427,160 @@ class Executor:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
 
+    def run_chained(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
+        steps: int = 1,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+    ):
+        """Run ``steps`` iterations of ``program`` as ONE compiled dispatch:
+        a ``lax.scan`` over the step body with the parameter state threaded
+        through the carry. Returns fetches stacked along a leading ``steps``
+        axis; the scope holds the final-step state, exactly as if ``run``
+        had been called ``steps`` times with the same feed.
+
+        This is the reference's run-the-loop-in-C++ role (trainer.cc
+        multi-iteration RunFromDataset) done the XLA way — and the honest
+        way to measure step time through a high-RTT dev tunnel: iterations
+        are data-dependent by construction (while-loop semantics serialize
+        the bodies), so wall time divided by ``steps`` is compute, not
+        dispatch rate. ``tools/perf_probe.py`` documents the protocol.
+
+        The same feed batch is used for every iteration (perf measurement /
+        overfit-one-batch semantics); real input pipelines stream via
+        DataLoader + ``run``. FLAGS_check_nan_inf is not supported here —
+        per-op flags would have to be stacked across steps; use ``run``.
+        """
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        feed = feed or {}
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in (fetch_list or [])]
+        if int(getattr(program, "_pipeline_microbatches", 1)) > 1:
+            raise NotImplementedError(
+                "run_chained with PipelineOptimizer programs: the pipeline "
+                "step is already a scan; nest via GradientMergeOptimizer")
+
+        feed_sig = tuple(sorted(
+            (n,) + _shape_dtype_sig(v) for n, v in feed.items()))
+        key = ("chained", self._program_fingerprint(program), feed_sig,
+               tuple(fetch_names), int(steps), id(scope))
+        step = self._cache.get(key)
+        if step is None:
+            block = program.global_block
+            io = analyze_block_io(block, set(feed.keys()), fetch_names)
+            base_step = make_step_fn(block, io, fetch_names)
+            idx = {n: i for i, n in enumerate(io["state_out"])}
+            wo_names = [n for n in io["state_out"] if n not in io["donated"]]
+
+            # Stateless programs (inference clones) have an empty carry, so
+            # XLA's loop-invariant code motion would hoist the whole body out
+            # of the scan and a timing of K iterations would measure ONE.
+            # Feed a runtime-zero perturbation chained off each step's first
+            # fetch into the first float feed: exact results (the scalar IS
+            # zero at runtime), but the compiler cannot prove it, so the
+            # bodies stay serialized. Training programs already chain through
+            # the donated params.
+            needs_chain = not io["donated"]
+
+            def multi_fn(feed_vals, donated_vals, ro_vals, keys, wo_init,
+                         chain_eps):
+                float_i = next(
+                    (i for i, v in enumerate(feed_vals)
+                     if jnp.issubdtype(jnp.result_type(v), jnp.inexact)),
+                    None) if needs_chain else None
+
+                def body(carry, k):
+                    donated, _, s = carry
+                    fv = list(feed_vals)
+                    if float_i is not None:
+                        fv[float_i] = fv[float_i] + (
+                            chain_eps * s).astype(fv[float_i].dtype)
+                    fetches, new_state = base_step(fv, donated, ro_vals, k)
+                    new_donated = [new_state[idx[n]] for n in io["donated"]]
+                    new_wo = [new_state[idx[n]] for n in wo_names]
+                    s_next = s
+                    if float_i is not None:
+                        for f in fetches:
+                            if jnp.issubdtype(jnp.result_type(f),
+                                              jnp.inexact):
+                                s_next = f.ravel()[0].astype(jnp.float32)
+                                break
+                    return (new_donated, new_wo, s_next), fetches
+
+                (fin_donated, fin_wo, _), stacked = jax.lax.scan(
+                    body, (donated_vals, wo_init, jnp.float32(0)), keys)
+                return stacked, fin_donated, fin_wo
+
+            jitted = jax.jit(multi_fn, donate_argnums=(1,))
+            step = _CompiledStep(jitted, io["feed_order"], io["donated"],
+                                 io["ro"], io["state_out"],
+                                 tuple(fetch_names))
+            step.program = program
+            step.wo_names = wo_names
+            step.io = io
+            step.base_step = base_step
+            step.wo_shapes = None
+            self._cache[key] = step
+
+        feed_vals = [self._to_device_array(feed[n], program, n)
+                     for n in step.feed_names]
+        donated_vals = [scope.find_var(n) for n in step.donated_names]
+        ro_vals = [scope.find_var(n) for n in step.ro_names]
+        for n, v in zip(step.donated_names + step.ro_names,
+                        donated_vals + ro_vals):
+            if v is None:
+                raise RuntimeError(
+                    f"Variable '{n}' is not initialized in scope — run the "
+                    f"startup program first")
+        keys = jax.random.split(
+            jax.random.key(self._next_seed(program)), steps)
+        # write-only persistables (produced fresh each step, never read):
+        # shape them abstractly so the scan carry can thread them
+        if step.wo_shapes is None:
+            out_shapes = jax.eval_shape(step.base_step, feed_vals,
+                                        donated_vals, ro_vals, keys[0])
+            wo_idx = {n: i for i, n in enumerate(step.io["state_out"])}
+            step.wo_shapes = [(out_shapes[1][wo_idx[n]].shape,
+                               out_shapes[1][wo_idx[n]].dtype)
+                              for n in step.wo_names]
+            if not step.donated_names:
+                # stateless program: the anti-hoisting chain (see multi_fn)
+                # needs a float feed to perturb AND a float fetch to carry;
+                # without both, XLA hoists the loop-invariant body and a
+                # timing of K steps measures ONE — warn loudly rather than
+                # let a benchmark silently report K x real throughput
+                has_float_feed = any(
+                    jnp.issubdtype(jnp.result_type(v), jnp.inexact)
+                    for v in feed_vals)
+                has_float_fetch = any(
+                    jnp.issubdtype(s.dtype, jnp.inexact)
+                    for s in out_shapes[0])
+                if not (has_float_feed and has_float_fetch):
+                    import warnings
+
+                    warnings.warn(
+                        "run_chained: program has no trainable state, no "
+                        "float feed/fetch pair to chain iterations through "
+                        "— XLA may hoist the body and execute it ONCE; do "
+                        "not use this timing as a per-step measurement",
+                        RuntimeWarning, stacklevel=3)
+        wo_init = [jnp.zeros(s, d) for s, d in step.wo_shapes]
+        with jax.default_device(self.place.jax_device()):
+            stacked, fin_donated, fin_wo = step.fn(
+                feed_vals, donated_vals, ro_vals, keys, wo_init,
+                jnp.float32(0))
+        for n, v in zip(step.donated_names, fin_donated):
+            scope.set_var(n, v)
+        for n, v in zip(step.wo_names, fin_wo):
+            scope.set_var(n, v)
+        if return_numpy:
+            return [np.asarray(v) for v in stacked]
+        return list(stacked)
+
     def close(self):
         self._cache.clear()
 

@@ -25,13 +25,21 @@ def _check_finite_and_unscale(ctx, ins, attrs):
     FoundInfinite is the bool flag. Zeroing (instead of the reference's
     skip-update) keeps the step a single static XLA program: an optimizer
     step over zero grads leaves params unchanged."""
+    from ..core.selected_rows import SelectedRows, is_selected_rows
+
     xs = ins.get("X", [])
     scale = x(ins, "Scale").reshape(()).astype(jnp.float32)
     found = jnp.zeros((), bool)
     for v in xs:
-        found = found | ~jnp.all(jnp.isfinite(v.astype(jnp.float32)))
+        vals = v.values if is_selected_rows(v) else v
+        found = found | ~jnp.all(jnp.isfinite(vals.astype(jnp.float32)))
     outs = []
     for v in xs:
+        if is_selected_rows(v):
+            u = (v.values.astype(jnp.float32) / scale).astype(v.values.dtype)
+            outs.append(SelectedRows(
+                v.rows, jnp.where(found, jnp.zeros_like(u), u), v.height))
+            continue
         unscaled = (v.astype(jnp.float32) / scale).astype(v.dtype)
         outs.append(jnp.where(found, jnp.zeros_like(unscaled), unscaled))
     return {"Out": outs, "FoundInfinite": [found.reshape((1,))]}

@@ -155,8 +155,24 @@ def _log_softmax(ctx, ins, attrs):
 # -- elementwise binary -----------------------------------------------------
 
 def _ew(fn):
+    # SelectedRows x scalar is value-wise ONLY for multiplicative ops (the
+    # implicit-zero untouched rows stay zero under *, /); add/max/etc. would
+    # need every vocab row touched — those densify loudly via the generic
+    # error instead of silently corrupting grads
+    sparse_ok = fn in (jnp.multiply, jnp.divide, jnp.true_divide)
+
     def lower(ctx, ins, attrs):
+        from ..core.selected_rows import is_selected_rows
+
         xv, yv = x(ins, "X"), x(ins, "Y")
+        if sparse_ok and is_selected_rows(xv) and not is_selected_rows(yv) \
+                and getattr(yv, "size", 0) == 1:
+            # sparse grad x scalar (global-norm clip's g * scale, loss-scale
+            # unscale): apply to values, keep the SelectedRows structure
+            from ..core.selected_rows import SelectedRows
+
+            return out(SelectedRows(xv.rows, fn(xv.values, yv.reshape(())),
+                                    xv.height))
         yv = broadcast_to_x(xv, yv, attrs.get("axis", -1))
         return out(fn(xv, yv))
 
@@ -182,7 +198,13 @@ for _name, _fn in [
 @register_op("scale", inputs=["X"], outputs=["Out"],
              attrs={"scale": 1.0, "bias": 0.0, "bias_after_scale": True})
 def _scale(ctx, ins, attrs):
+    from ..core.selected_rows import is_selected_rows
+
     v = x(ins)
+    if is_selected_rows(v):
+        # grad scaling (1/N, loss scale): bias on a sparse grad is malformed
+        assert attrs.get("bias", 0.0) == 0.0, "scale(SelectedRows) with bias"
+        return out(v.scale(attrs["scale"]))
     if attrs.get("bias_after_scale", True):
         return out(v * attrs["scale"] + attrs["bias"])
     return out((v + attrs["bias"]) * attrs["scale"])
@@ -190,7 +212,24 @@ def _scale(ctx, ins, attrs):
 
 @register_op("sum", inputs=[IOSpec("X", duplicable=True)], outputs=["Out"])
 def _sum(ctx, ins, attrs):
+    from ..core.selected_rows import concat_merge, is_selected_rows
+
     vals = [v for v in ins.get("X", []) if v is not None]
+    sparse = [v for v in vals if is_selected_rows(v)]
+    if sparse:
+        # multi-consumer grads of a shared is_sparse table (backward.py's
+        # sum-dedup): concat + re-merge stays O(touched rows). Mixed
+        # dense+sparse densifies (reference selected_rows_functor.cc Add).
+        acc = sparse[0]
+        for v in sparse[1:]:
+            acc = concat_merge(acc, v)
+        dense = [v for v in vals if not is_selected_rows(v)]
+        if not dense:
+            return out(acc)
+        d = dense[0]
+        for v in dense[1:]:
+            d = d + v
+        return out(d + acc.to_dense())
     acc = vals[0]
     for v in vals[1:]:
         acc = acc + v
@@ -205,12 +244,27 @@ def _cast(ctx, ins, attrs):
 
 @register_op("clip", inputs=["X"], outputs=["Out"], attrs={"min": -1.0, "max": 1.0})
 def _clip(ctx, ins, attrs):
-    return out(jnp.clip(x(ins), attrs["min"], attrs["max"]))
+    from ..core.selected_rows import SelectedRows, is_selected_rows
+
+    v = x(ins)
+    if is_selected_rows(v):
+        return out(SelectedRows(
+            v.rows, jnp.clip(v.values, attrs["min"], attrs["max"]),
+            v.height))
+    return out(jnp.clip(v, attrs["min"], attrs["max"]))
 
 
 @register_op("clip_by_norm", inputs=["X"], outputs=["Out"], attrs={"max_norm": 1.0})
 def _clip_by_norm(ctx, ins, attrs):
+    from ..core.selected_rows import SelectedRows, is_selected_rows
+
     v = x(ins)
+    if is_selected_rows(v):
+        # rows are duplicate-free (merged at creation), so the values norm
+        # IS the grad norm — reference clip_by_norm_op.h SelectedRows path
+        norm = jnp.sqrt(jnp.sum(jnp.square(v.values)))
+        s = jnp.minimum(attrs["max_norm"] / jnp.maximum(norm, 1e-12), 1.0)
+        return out(SelectedRows(v.rows, v.values * s, v.height))
     norm = jnp.sqrt(jnp.sum(jnp.square(v)))
     scale = jnp.minimum(attrs["max_norm"] / jnp.maximum(norm, 1e-12), 1.0)
     return out(v * scale)
@@ -218,7 +272,12 @@ def _clip_by_norm(ctx, ins, attrs):
 
 @register_op("squared_l2_norm", inputs=["X"], outputs=["Out"])
 def _squared_l2_norm(ctx, ins, attrs):
-    return out(jnp.sum(jnp.square(x(ins))).reshape((1,)))
+    from ..core.selected_rows import is_selected_rows
+
+    v = x(ins)
+    if is_selected_rows(v):
+        return out(jnp.sum(jnp.square(v.values)).reshape((1,)))
+    return out(jnp.sum(jnp.square(v)).reshape((1,)))
 
 
 # -- comparison / logical (non-differentiable) ------------------------------

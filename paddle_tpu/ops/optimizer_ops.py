@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..core.selected_rows import is_selected_rows
 from .common import IOSpec, register_op, x
 
 
@@ -17,7 +18,13 @@ from .common import IOSpec, register_op, x
              outputs=["ParamOut"], grad=None)
 def _sgd(ctx, ins, attrs):
     p, g, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "LearningRate")
-    return {"ParamOut": [p - lr.reshape(()).astype(p.dtype) * g.astype(p.dtype)]}
+    lr = lr.reshape(()).astype(p.dtype)
+    if is_selected_rows(g):
+        # reference sgd_op.h sparse branch: update only touched rows;
+        # sentinel-padded rows fall off via scatter mode="drop"
+        return {"ParamOut": [p.at[g.rows].add(
+            -lr * g.values.astype(p.dtype), mode="drop")]}
+    return {"ParamOut": [p - lr * g.astype(p.dtype)]}
 
 
 @register_op("momentum", inputs=["Param", "Grad", "Velocity", "LearningRate"],
@@ -26,9 +33,34 @@ def _sgd(ctx, ins, attrs):
                     "regularization_method": "", "regularization_coeff": 0.0},
              grad=None)
 def _momentum(ctx, ins, attrs):
-    p, g = x(ins, "Param"), x(ins, "Grad").astype(x(ins, "Param").dtype)
+    p = x(ins, "Param")
+    g = x(ins, "Grad")
     v, lr = x(ins, "Velocity"), x(ins, "LearningRate").reshape(())
     mu = attrs["mu"]
+    if is_selected_rows(g):
+        # dense-semantics momentum with a sparse grad (missing rows carry
+        # g=0 but their velocity still decays — reference momentum_op.h
+        # DenseMomentumFunctor over a SelectedRows grad): the grad never
+        # materializes dense, only elementwise O(vocab) state math remains
+        gv = g.values.astype(p.dtype)
+        if attrs.get("regularization_method") == "l2_decay":
+            v_out = (mu * v + attrs["regularization_coeff"] * p).at[
+                g.rows].add(gv, mode="drop")
+            if attrs.get("use_nesterov"):
+                p_out = (p - lr * (attrs["regularization_coeff"] * p
+                                   + mu * v_out)).at[g.rows].add(
+                    -lr * gv, mode="drop")
+            else:
+                p_out = p - lr * v_out
+            return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+        v_out = (mu * v).at[g.rows].add(gv, mode="drop")
+        if attrs.get("use_nesterov"):
+            p_out = (p - lr * mu * v_out).at[g.rows].add(-lr * gv,
+                                                         mode="drop")
+        else:
+            p_out = p - lr * v_out
+        return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+    g = g.astype(p.dtype)
     if attrs.get("regularization_method") == "l2_decay":
         g = g + attrs["regularization_coeff"] * p
     v_out = mu * v + g
@@ -69,20 +101,43 @@ def _lars_momentum(ctx, ins, attrs):
              grad=None)
 def _adam(ctx, ins, attrs):
     p = x(ins, "Param")
-    g = x(ins, "Grad").astype(p.dtype)
+    g = x(ins, "Grad")
     lr = x(ins, "LearningRate").reshape(())
     m1, m2 = x(ins, "Moment1"), x(ins, "Moment2")
     b1p, b2p = x(ins, "Beta1Pow").reshape(()), x(ins, "Beta2Pow").reshape(())
     b1, b2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
-    m1_out = b1 * m1 + (1 - b1) * g
-    m2_out = b2 * m2 + (1 - b2) * g * g
     # bias correction uses the CURRENT pow accumulators (initialised to beta
     # at step 1), matching reference adam_op.h; pows advance afterwards
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    pows = {"Beta1PowOut": [(b1p * b1).reshape((1,))],
+            "Beta2PowOut": [(b2p * b2).reshape((1,))]}
+    if is_selected_rows(g):
+        gv = g.values.astype(p.dtype)
+        rows = g.rows
+        if attrs.get("lazy_mode"):
+            # reference adam_op.h SparseAdamFunctor lazy_mode: moments and
+            # param touched ONLY at grad rows — O(touched x dim) update
+            m1_r = b1 * m1[rows] + (1 - b1) * gv
+            m2_r = b2 * m2[rows] + (1 - b2) * gv * gv
+            upd = -lr_t * m1_r / (jnp.sqrt(m2_r) + eps)
+            return {"ParamOut": [p.at[rows].add(upd, mode="drop")],
+                    "Moment1Out": [m1.at[rows].set(m1_r, mode="drop")],
+                    "Moment2Out": [m2.at[rows].set(m2_r, mode="drop")],
+                    **pows}
+        # non-lazy dense semantics (missing rows see g=0: moments decay,
+        # params still move on the decayed moment) without materializing a
+        # dense grad — scatter the (1-beta) terms into the decayed moments
+        m1_out = (b1 * m1).at[rows].add((1 - b1) * gv, mode="drop")
+        m2_out = (b2 * m2).at[rows].add((1 - b2) * gv * gv, mode="drop")
+        p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+        return {"ParamOut": [p_out], "Moment1Out": [m1_out],
+                "Moment2Out": [m2_out], **pows}
+    g = g.astype(p.dtype)
+    m1_out = b1 * m1 + (1 - b1) * g
+    m2_out = b2 * m2 + (1 - b2) * g * g
     p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
     return {"ParamOut": [p_out], "Moment1Out": [m1_out], "Moment2Out": [m2_out],
-            "Beta1PowOut": [(b1p * b1).reshape((1,))],
-            "Beta2PowOut": [(b2p * b2).reshape((1,))]}
+            **pows}
 
 
 @register_op("adamw",
@@ -107,6 +162,14 @@ def _adamw(ctx, ins, attrs):
 def _adagrad(ctx, ins, attrs):
     p, g = x(ins, "Param"), x(ins, "Grad")
     m, lr = x(ins, "Moment"), x(ins, "LearningRate").reshape(())
+    if is_selected_rows(g):
+        # adagrad with g=0 is the identity, so the touched-rows update IS
+        # dense semantics (reference adagrad_op.h sparse branch)
+        gv = g.values.astype(p.dtype)
+        m_r = m[g.rows] + gv * gv
+        upd = -lr * gv / (jnp.sqrt(m_r) + attrs["epsilon"])
+        return {"ParamOut": [p.at[g.rows].add(upd, mode="drop")],
+                "MomentOut": [m.at[g.rows].set(m_r, mode="drop")]}
     m_out = m + g * g
     p_out = p - lr * g / (jnp.sqrt(m_out) + attrs["epsilon"])
     return {"ParamOut": [p_out], "MomentOut": [m_out]}

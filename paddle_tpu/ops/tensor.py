@@ -254,10 +254,35 @@ def _one_hot(ctx, ins, attrs):
                               dtype=np_dtype(attrs["dtype"])))
 
 
+def _lookup_table_grad(ctx, ins, attrs):
+    """Custom grad: SelectedRows for ``is_sparse`` tables (reference
+    selected_rows.h:32 — grads sized by touched rows, not vocab), dense
+    scatter-add otherwise. Both share id canonicalization: squeeze the
+    trailing 1, clip OOB ids to match the forward gather's mode="clip",
+    route padding_idx rows to the drop sentinel (their forward output was
+    zeroed, so their gradient is zero by construction)."""
+    from ..core.selected_rows import SelectedRows, merge_rows
+
+    w, ids, g = x(ins, "W"), x(ins, "Ids"), x(ins, "Out@GRAD")
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    height, dim = w.shape[0], w.shape[-1]
+    ids_flat = jnp.clip(ids.reshape(-1).astype(jnp.int32), 0, height - 1)
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad != -1:
+        ids_flat = jnp.where(ids_flat == pad, height, ids_flat)
+    g_flat = g.reshape(-1, dim).astype(w.dtype)
+    if attrs.get("is_sparse") or attrs.get("is_distributed"):
+        return {"W@GRAD": [merge_rows(ids_flat, g_flat, height)]}
+    dense = jnp.zeros_like(w).at[ids_flat].add(g_flat, mode="drop")
+    return {"W@GRAD": [dense]}
+
+
 @register_op("lookup_table", inputs=[IOSpec("W"), IOSpec("Ids", no_grad=True)],
              outputs=["Out"],
              attrs={"is_sparse": False, "is_distributed": False,
-                    "padding_idx": -1, "remote_prefetch": False})
+                    "padding_idx": -1, "remote_prefetch": False},
+             grad_lower=_lookup_table_grad)
 def _lookup_table(ctx, ins, attrs):
     w, ids = x(ins, "W"), x(ins, "Ids")
     squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
@@ -274,7 +299,8 @@ def _lookup_table(ctx, ins, attrs):
 
 
 @register_op("lookup_table_v2", inputs=[IOSpec("W"), IOSpec("Ids", no_grad=True)],
-             outputs=["Out"], attrs={"is_sparse": False, "padding_idx": -1})
+             outputs=["Out"], attrs={"is_sparse": False, "padding_idx": -1},
+             grad_lower=_lookup_table_grad)
 def _lookup_table_v2(ctx, ins, attrs):
     return _lookup_table(ctx, ins, attrs)
 

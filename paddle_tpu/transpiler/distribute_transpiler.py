@@ -123,28 +123,32 @@ class DistributeTranspiler:
         """Reference :494. Records the cluster layout; the program itself is
         NOT rewritten (no send/recv splicing — collectives are inserted by
         GSPMD at compile time, multi_devices_graph_pass.cc:454's job)."""
-        if self.config.geo_sgd_mode:
-            raise NotImplementedError(_GEO_MIGRATION_MSG)
-        if not sync_mode or not self.config.sync_mode:
-            raise NotImplementedError(_ASYNC_MIGRATION_MSG)
-        if self.config.enable_dc_asgd:
-            raise NotImplementedError(_ASYNC_MIGRATION_MSG)
-
         self.origin_program = program or default_main_program()
         self.startup_program = startup_program or default_startup_program()
         self.trainer_id = trainer_id
         self.sync_mode = sync_mode
 
-        if self.config.mode == "nccl2":
+        if self.config.mode in ("nccl2", "collective"):
+            # reference nccl2 path ignores sync_mode (distribute_transpiler
+            # .py:560 returns before the pserver machinery); collective mode
+            # (reference _transpile_collective) likewise only records the
+            # cluster layout — bootstrap is distributed.init_parallel_env
             if not isinstance(trainers, str):
-                raise ValueError("nccl2 mode takes trainers as a comma-"
-                                 "separated endpoint string")
+                raise ValueError(f"{self.config.mode} mode takes trainers as "
+                                 "a comma-separated endpoint string")
             self.trainer_endpoints = trainers.split(",")
             self.trainer_num = len(self.trainer_endpoints)
             self.current_endpoint = current_endpoint
             self.origin_program._trainers_endpoints = self.trainer_endpoints
             self._transpiled = True
             return
+
+        if self.config.geo_sgd_mode:
+            raise NotImplementedError(_GEO_MIGRATION_MSG)
+        if not sync_mode or not self.config.sync_mode:
+            raise NotImplementedError(_ASYNC_MIGRATION_MSG)
+        if self.config.enable_dc_asgd:
+            raise NotImplementedError(_ASYNC_MIGRATION_MSG)
 
         self.trainer_num = int(trainers)
         self.pserver_endpoints = [ep.strip() for ep in pservers.split(",")]
@@ -153,7 +157,7 @@ class DistributeTranspiler:
         # lived on (kept so checkpoint tooling can answer layout questions;
         # nothing at runtime consumes it — GSPMD owns real placement)
         dispatcher = self.config.split_method(self.pserver_endpoints)
-        params = [v for v in self.origin_program.global_block().vars.values()
+        params = [v for v in self.origin_program.global_block.vars.values()
                   if getattr(v, "trainable", False)
                   or type(v).__name__ == "Parameter"]
         self.param_grad_ep_mapping = {ep: {"params": [], "grads": []}

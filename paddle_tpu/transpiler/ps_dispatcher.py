@@ -38,7 +38,12 @@ class HashName(PSDispatcher):
 
     def _hash_block(self, block_str, total):
         # stable across processes (builtin hash() is salted per-interpreter,
-        # which would scatter the same var to different servers per rank)
+        # which would scatter the same var to different servers per rank).
+        # This intentionally DIVERGES from the reference's builtin hash():
+        # the var->endpoint layout here answers "which shard would this
+        # param have lived on" for checkpoint tooling within THIS framework
+        # only — nothing consumes reference-layout parity, and the
+        # reference's own layout was never stable across interpreters.
         import zlib
 
         return zlib.crc32(block_str.encode()) % total

@@ -1,0 +1,84 @@
+"""Executor.run_chained — K scanned steps must equal K separate run() calls.
+
+This is the compiled-train-loop role (reference trainer.cc RunFromDataset
+runs the loop outside Python) and the measurement substrate for bench.py:
+iterations inside one dispatch are serialized by while-loop semantics, so
+timing it measures compute, not dispatch rate.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build(with_bn=False):
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(input=x, size=8, act="relu")
+    if with_bn:
+        h = fluid.layers.batch_norm(input=h)
+    pred = fluid.layers.fc(input=h, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _feed():
+    rng = np.random.RandomState(3)
+    return {"x": rng.rand(8, 4).astype(np.float32),
+            "y": rng.rand(8, 1).astype(np.float32)}
+
+
+def test_chained_matches_sequential_runs():
+    for with_bn in (False, True):
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            loss = _build(with_bn)
+            main, startup = (fluid.default_main_program(),
+                             fluid.default_startup_program())
+            feed = _feed()
+            exe = fluid.Executor(fluid.CPUPlace())
+
+            s1 = fluid.Scope()
+            with fluid.scope_guard(s1):
+                exe.run(startup)
+                seq = [float(np.asarray(exe.run(main, feed=feed,
+                                                fetch_list=[loss])[0]))
+                       for _ in range(4)]
+            exe2 = fluid.Executor(fluid.CPUPlace())
+            s2 = fluid.Scope()
+            with fluid.scope_guard(s2):
+                exe2.run(startup)
+                chained = exe2.run_chained(main, feed=feed,
+                                           fetch_list=[loss], steps=4)
+            got = np.asarray(chained[0]).reshape(-1)
+            assert got.shape == (4,)
+            # same math modulo per-step dropout keys (none here) — the loss
+            # trajectory must match the sequential path step for step
+            np.testing.assert_allclose(got, seq, rtol=2e-5, atol=1e-6)
+            # final state matches too (params after 4 updates)
+            params = [v.name for v in main.global_block.vars.values()
+                      if type(v).__name__ == "Parameter"]
+            assert params
+            for n in params:
+                np.testing.assert_allclose(s1.numpy(n), s2.numpy(n),
+                                           rtol=2e-5, atol=1e-6)
+
+
+def test_chained_inference_no_state():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=2, act="softmax")
+        main, startup = (fluid.default_main_program(),
+                         fluid.default_startup_program())
+        infer = main.clone(for_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        feed = {"x": np.random.RandomState(0).rand(4, 4).astype(np.float32)}
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            one = exe.run(infer, feed=feed, fetch_list=[pred])[0]
+            stacked = exe.run_chained(infer, feed=feed, fetch_list=[pred],
+                                      steps=3)[0]
+        assert np.asarray(stacked).shape == (3,) + np.asarray(one).shape
+        for i in range(3):
+            np.testing.assert_allclose(np.asarray(stacked)[i],
+                                       np.asarray(one), rtol=1e-6)

@@ -1,0 +1,195 @@
+"""Sparse embedding gradients (SelectedRows role).
+
+Reference: paddle/fluid/framework/selected_rows.h:32 (the {rows, value,
+height} gradient type of is_sparse lookups), operators/optimizers/adam_op.h
+SparseAdamFunctor (lazy/non-lazy), sgd_op.h + adagrad_op.h sparse branches.
+Here the grad of an ``is_sparse`` lookup_table is a SelectedRows pytree with
+rows sized by touched ids (batch x seq), NOT vocab — verified structurally
+below — and every sparse-vs-dense pair must converge identically where the
+semantics are dense-equivalent.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.selected_rows import (SelectedRows, concat_merge,
+                                           is_selected_rows, merge_rows)
+
+VOCAB, DIM, BATCH, SEQ = 50, 8, 4, 6
+
+
+def test_merge_rows_dedups_and_pads():
+    import jax.numpy as jnp
+
+    ids = jnp.array([3, 1, 3, 7, 1, 3], dtype=jnp.int32)
+    vals = jnp.arange(6, dtype=jnp.float32)[:, None] * jnp.ones((6, 2))
+    sr = merge_rows(ids, vals, height=10)
+    assert sr.rows.shape == (6,)
+    dense = np.asarray(sr.to_dense())
+    expect = np.zeros((10, 2), np.float32)
+    for i, r in enumerate([3, 1, 3, 7, 1, 3]):
+        expect[r] += i
+    np.testing.assert_allclose(dense, expect)
+    # canonical: unique rows lead, sentinel (height) pads the tail
+    rows = np.asarray(sr.rows)
+    assert sorted(rows[:3].tolist()) == [1, 3, 7]
+    assert (rows[3:] == 10).all()
+
+
+def test_concat_merge_sums_shared_table_grads():
+    import jax.numpy as jnp
+
+    a = merge_rows(jnp.array([1, 2]), jnp.ones((2, 3)), 5)
+    b = merge_rows(jnp.array([2, 4]), 2 * jnp.ones((2, 3)), 5)
+    dense = np.asarray(concat_merge(a, b).to_dense())
+    expect = np.zeros((5, 3), np.float32)
+    expect[1] += 1
+    expect[2] += 3
+    expect[4] += 2
+    np.testing.assert_allclose(dense, expect)
+
+
+def _build_emb_net(is_sparse, optimizer, padding_idx=None):
+    ids = fluid.layers.data(name="ids", shape=[SEQ], dtype="int64")
+    emb = fluid.layers.embedding(
+        input=ids, size=[VOCAB, DIM], is_sparse=is_sparse,
+        padding_idx=padding_idx, param_attr=fluid.ParamAttr(name="emb_w"))
+    # touch only some rows; a dense fc after keeps the grad path realistic
+    pooled = fluid.layers.reduce_mean(emb, dim=1)
+    pred = fluid.layers.fc(input=pooled, size=1,
+                           param_attr=fluid.ParamAttr(name="head_w"))
+    loss = fluid.layers.mean(pred * pred)
+    optimizer().minimize(loss)
+    return loss
+
+
+def _train(is_sparse, optimizer, steps=3, padding_idx=None, fetch_grad=False):
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        loss = _build_emb_net(is_sparse, optimizer, padding_idx)
+        main, startup = (fluid.default_main_program(),
+                         fluid.default_startup_program())
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(0)
+        feeds = [{"ids": rng.randint(0, VOCAB, (BATCH, SEQ)).astype(np.int64)}
+                 for _ in range(steps)]
+        grads = None
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            losses = []
+            for f in feeds:
+                fetch = [loss] + (["emb_w@GRAD"] if fetch_grad else [])
+                outs = exe.run(main, feed=f, fetch_list=fetch,
+                               return_numpy=False)
+                losses.append(float(np.asarray(outs[0]).reshape(-1)[0]))
+                if fetch_grad:
+                    grads = outs[1]
+            w = scope.numpy("emb_w")
+    return losses, w, grads
+
+
+SGD = lambda: fluid.optimizer.SGD(learning_rate=0.1)
+MOMENTUM = lambda: fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+NESTEROV = lambda: fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                            use_nesterov=True)
+ADAM = lambda: fluid.optimizer.Adam(learning_rate=0.05)
+ADAGRAD = lambda: fluid.optimizer.Adagrad(learning_rate=0.1)
+
+
+@pytest.mark.parametrize("opt", [SGD, MOMENTUM, NESTEROV, ADAM, ADAGRAD],
+                         ids=["sgd", "momentum", "nesterov", "adam",
+                              "adagrad"])
+def test_sparse_matches_dense_training(opt):
+    """Sparse grads use dense-equivalent update semantics (non-lazy): the
+    parameter trajectory must match the dense path bit-for-bit-ish."""
+    dense_losses, dense_w, _ = _train(False, opt)
+    sparse_losses, sparse_w, _ = _train(True, opt)
+    np.testing.assert_allclose(sparse_losses, dense_losses, rtol=1e-5)
+    np.testing.assert_allclose(sparse_w, dense_w, rtol=1e-5, atol=1e-7)
+
+
+def test_grad_is_selected_rows_sized_by_touched_ids():
+    """The structural claim: an is_sparse lookup's grad buffer is
+    [batch*seq, dim] + an int32 row vector — not [vocab, dim]."""
+    _, _, grad = _train(True, SGD, steps=1, fetch_grad=True)
+    assert is_selected_rows(grad)
+    assert grad.values.shape == (BATCH * SEQ, DIM)
+    assert grad.rows.shape == (BATCH * SEQ,)
+    assert grad.height == VOCAB
+    _, _, dense_grad = _train(False, SGD, steps=1, fetch_grad=True)
+    assert not is_selected_rows(dense_grad)
+    assert np.asarray(dense_grad).shape == (VOCAB, DIM)
+
+
+def test_sparse_padding_idx_rows_get_no_update():
+    losses, w, grad = _train(True, SGD, steps=2, padding_idx=3,
+                             fetch_grad=True)
+    # padding row's grad is dropped entirely (forward zeroed its output)
+    assert not np.asarray((grad.rows == 3).any())
+    d_losses, d_w, _ = _train(False, SGD, steps=2, padding_idx=3)
+    np.testing.assert_allclose(w, d_w, rtol=1e-5, atol=1e-7)
+
+
+def test_lazy_adam_touches_only_grad_rows():
+    """lazy_mode=True (reference adam_op.h lazy branch): untouched rows'
+    moments must NOT decay and their params must NOT move."""
+    lazy = lambda: fluid.optimizer.Adam(learning_rate=0.05, lazy_mode=True)
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        loss = _build_emb_net(True, lazy)
+        main, startup = (fluid.default_main_program(),
+                         fluid.default_startup_program())
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        ids = np.full((BATCH, SEQ), 5, np.int64)  # touch ONLY row 5
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            w0 = scope.numpy("emb_w").copy()
+            exe.run(main, feed={"ids": ids}, fetch_list=[loss])
+            w1 = scope.numpy("emb_w")
+        untouched = np.ones(VOCAB, bool)
+        untouched[5] = False
+        np.testing.assert_array_equal(w1[untouched], w0[untouched])
+        assert np.abs(w1[5] - w0[5]).max() > 0
+
+
+def test_sparse_with_global_norm_clip():
+    """r5 review finding: clip/AMP ops must accept SelectedRows grads."""
+    def opt():
+        fluid.clip.set_gradient_clip(
+            fluid.clip.GradientClipByGlobalNorm(clip_norm=0.01))
+        return fluid.optimizer.SGD(learning_rate=0.1)
+
+    losses, w, _ = _train(True, opt, steps=3)
+    d_losses, d_w, _ = _train(False, opt, steps=3)
+    np.testing.assert_allclose(losses, d_losses, rtol=1e-5)
+    np.testing.assert_allclose(w, d_w, rtol=1e-5, atol=1e-7)
+
+
+def test_sparse_with_dynamic_loss_scaling():
+    from paddle_tpu.contrib import mixed_precision as mp
+
+    def opt():
+        return mp.decorate(fluid.optimizer.SGD(learning_rate=0.1),
+                           use_dynamic_loss_scaling=True,
+                           init_loss_scaling=128.0)
+
+    losses, w, _ = _train(True, opt, steps=3)
+    d_losses, d_w, _ = _train(False, opt, steps=3)
+    np.testing.assert_allclose(losses, d_losses, rtol=1e-5)
+    np.testing.assert_allclose(w, d_w, rtol=1e-4, atol=1e-6)
+
+
+def test_chained_run_with_sparse_grads():
+    """SelectedRows must survive the run_chained scan path (it is a pytree)."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        loss = _build_emb_net(True, SGD)
+        main, startup = (fluid.default_main_program(),
+                         fluid.default_startup_program())
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        feed = {"ids": np.random.RandomState(1).randint(
+            0, VOCAB, (BATCH, SEQ)).astype(np.int64)}
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            out = exe.run_chained(main, feed=feed, fetch_list=[loss], steps=3)
+        assert np.asarray(out[0]).shape == (3,)

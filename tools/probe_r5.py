@@ -1,0 +1,74 @@
+"""Round-5 measurement-integrity probe. Small, prints progress as it goes.
+
+Protocol (perf_probe.py): data-dependent chain inside ONE jit (lax.scan),
+host float() fetch as the sync point, RTT removed by differencing two chain
+lengths. Everything here is sized to finish in minutes through the tunnel.
+"""
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+V5E_PEAK = 197.0
+RNG = np.random.RandomState(0)
+
+
+def timed(f, iters=3):
+    float(f())  # compile + warm
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        float(f())
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def probe_matmul(n=4096, k_short=4, k_long=64):
+    a = jax.device_put(RNG.randn(n, n).astype(np.float32)).astype(jnp.bfloat16)
+    b = jax.device_put(RNG.randn(n, n).astype(np.float32)).astype(jnp.bfloat16)
+
+    def make(k):
+        @jax.jit
+        def f():
+            def body(x, _):
+                return (x @ b) * (1.0 / n), None
+            x, _ = jax.lax.scan(body, a, None, length=k)
+            return x.astype(jnp.float32).sum()
+        return f
+
+    print(f"[{time.strftime('%H:%M:%S')}] compiling matmul k={k_short}...",
+          flush=True)
+    t_s = timed(make(k_short))
+    print(f"[{time.strftime('%H:%M:%S')}] k={k_short}: {t_s*1e3:.1f} ms total",
+          flush=True)
+    t_l = timed(make(k_long))
+    dt = (t_l - t_s) / (k_long - k_short)
+    tf = 2 * n**3 / dt / 1e12
+    print(f"matmul {n}^3 bf16: {dt*1e3:.3f} ms/iter, {tf:.1f} TF/s "
+          f"({100*tf/V5E_PEAK:.0f}% peak); rtt~{t_s - k_short*dt:.3f}s",
+          flush=True)
+    return dt
+
+
+def probe_rtt():
+    x = jax.device_put(np.float32(1.0))
+    f = jax.jit(lambda v: v + 1)
+    float(f(x))
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(f(x))
+        ts.append(time.perf_counter() - t0)
+    print(f"dispatch+fetch RTT (tiny jit): min {min(ts)*1e3:.1f} ms, "
+          f"median {sorted(ts)[2]*1e3:.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    print("backend:", jax.default_backend(), jax.devices(), flush=True)
+    probe_rtt()
+    which = sys.argv[1] if len(sys.argv) > 1 else "matmul"
+    if which == "matmul":
+        probe_matmul()
