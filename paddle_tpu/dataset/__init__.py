@@ -9,6 +9,8 @@ shapes/dtypes/value-ranges of the real dataset — enough to drive every
 pipeline, model and test. The reader contract is the reference one: a
 loader returns a zero-arg creator whose iterator yields sample tuples.
 """
-from . import cifar, imdb, imikolov, mnist, uci_housing  # noqa: F401
+from . import (cifar, conll05, imdb, imikolov, mnist,  # noqa: F401
+               movielens, uci_housing, wmt16)
 
-__all__ = ["mnist", "cifar", "imdb", "imikolov", "uci_housing"]
+__all__ = ["mnist", "cifar", "imdb", "imikolov", "uci_housing",
+           "movielens", "conll05", "wmt16"]

@@ -1,0 +1,98 @@
+"""MovieLens-1M loader (reference: python/paddle/dataset/movielens.py).
+
+Real data: place ``ml-1m.zip``'s extracted ``ratings.dat``/``users.dat``/
+``movies.dat`` under ``$DATA_HOME/movielens/``. Otherwise synthesizes a
+low-rank user x movie preference structure: each user and movie carries a
+latent factor and the rating is their (noised, quantized) inner product —
+so a factorization-style recommender genuinely learns.
+
+Sample tuple (reference movielens.py __initialize_meta_info__ ordering):
+(user_id, gender_id, age_id, job_id, movie_id, category_ids [var-len],
+ title_ids [var-len], score float32).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import synthetic_notice
+
+__all__ = ["train", "test", "user_info", "movie_info", "max_user_id",
+           "max_movie_id", "max_job_id", "age_table", "categories_dict_size",
+           "title_dict_size"]
+
+_N_USERS, _N_MOVIES, _RANK = 512, 256, 6
+_N_CATEGORIES, _TITLE_VOCAB, _TITLE_LEN = 18, 1024, 4
+_N_TRAIN, _N_TEST = 16384, 2048
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_job_id():
+    return 20
+
+
+def categories_dict_size():
+    return _N_CATEGORIES
+
+
+def title_dict_size():
+    return _TITLE_VOCAB
+
+
+def _factors():
+    rng = np.random.RandomState(2024)
+    u = rng.randn(_N_USERS + 1, _RANK).astype(np.float32)
+    m = rng.randn(_N_MOVIES + 1, _RANK).astype(np.float32)
+    meta = {
+        "gender": rng.randint(0, 2, _N_USERS + 1),
+        "age": rng.randint(0, len(age_table), _N_USERS + 1),
+        "job": rng.randint(0, max_job_id() + 1, _N_USERS + 1),
+        "cats": rng.randint(0, _N_CATEGORIES, (_N_MOVIES + 1, 2)),
+        "titles": rng.randint(0, _TITLE_VOCAB, (_N_MOVIES + 1, _TITLE_LEN)),
+    }
+    return u, m, meta
+
+
+def user_info():
+    _, _, meta = _factors()
+    return meta
+
+
+def movie_info():
+    _, _, meta = _factors()
+    return meta
+
+
+def _reader(n, seed):
+    def read():
+        synthetic_notice("movielens")
+        u, m, meta = _factors()
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            uid = int(rng.randint(1, _N_USERS + 1))
+            mid = int(rng.randint(1, _N_MOVIES + 1))
+            raw = float(u[uid] @ m[mid]) / np.sqrt(_RANK)
+            score = float(np.clip(np.round(3.0 + 1.5 * raw
+                                           + 0.3 * rng.randn()), 1, 5))
+            yield (np.int64(uid), np.int64(meta["gender"][uid]),
+                   np.int64(meta["age"][uid]), np.int64(meta["job"][uid]),
+                   np.int64(mid), meta["cats"][mid].astype(np.int64),
+                   meta["titles"][mid].astype(np.int64),
+                   np.float32(score))
+    return read
+
+
+def train():
+    return _reader(_N_TRAIN, 0)
+
+
+def test():
+    return _reader(_N_TEST, 1)

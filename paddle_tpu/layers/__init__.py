@@ -18,8 +18,8 @@ from .sequence import (sequence_pool, sequence_softmax,  # noqa: F401
                        sequence_erase, sequence_enumerate, sequence_conv,
                        sequence_first_step, sequence_last_step, sequence_mask)
 from . import sequence  # noqa: F401
-from .rnn import (DynamicRNN, dynamic_lstm, dynamic_gru,  # noqa: F401
-                  gru_unit, lstm, warpctc)
+from .rnn import (DynamicRNN, dynamic_lstm, dynamic_lstmp,  # noqa: F401
+                  dynamic_gru, gru_unit, lstm, warpctc)
 from . import rnn  # noqa: F401
 from . import detection  # noqa: F401
 from .pipeline import PipelineRegion  # noqa: F401

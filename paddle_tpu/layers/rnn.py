@@ -11,7 +11,8 @@ import numpy as np
 from ..layer_helper import LayerHelper
 from .sequence import _make_lod_out, lod_suffix, seq_len_var
 
-__all__ = ["dynamic_lstm", "dynamic_gru", "gru_unit", "lstm", "warpctc"]
+__all__ = ["dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "gru_unit",
+           "lstm", "warpctc"]
 
 
 def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
@@ -47,6 +48,68 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
     helper.append_op("assign", inputs={"X": seq_len_var(input)},
                      outputs={"Out": lod})
     return hidden, cell
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, h_0=None, c_0=None,
+                  cell_clip=None, proj_clip=None):
+    """LSTM with recurrent projection (reference nn.py:583 dynamic_lstmp):
+    input [B, T, 4H] pre-projected; size = 4H; returns (projection [B,T,P],
+    cell [B,H])."""
+    helper = LayerHelper("dynamic_lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    hidden_dim = size // 4
+    from ..param_attr import ParamAttr
+
+    attrs = ParamAttr._to_attr(param_attr)
+    if not isinstance(attrs, list):
+        # one attr supplied (or none): the projection weight gets its own
+        # derived name — reusing the attr verbatim would silently alias the
+        # two differently-shaped parameters under one var name
+        proj_attr = ParamAttr(
+            name=(attrs.name + "_proj") if attrs.name else None,
+            initializer=attrs.initializer,
+            learning_rate=attrs.learning_rate,
+            regularizer=attrs.regularizer, trainable=attrs.trainable)
+        attrs = [attrs, proj_attr]
+    elif len(attrs) != 2:
+        raise ValueError("dynamic_lstmp takes 1 or 2 param_attr entries "
+                         "(Weight, ProjWeight)")
+    w = helper.create_parameter(attrs[0],
+                                shape=[proj_size, 4 * hidden_dim],
+                                dtype=dtype)
+    w_proj = helper.create_parameter(attrs[1],
+                                     shape=[hidden_dim, proj_size],
+                                     dtype=dtype)
+    bias_size = 7 * hidden_dim if use_peepholes else 4 * hidden_dim
+    b = helper.create_parameter(helper.bias_attr, shape=[1, bias_size],
+                                dtype=dtype, is_bias=True)
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    _make_lod_out(helper, proj)
+    ins = {"Input": input, "Weight": w, "ProjWeight": w_proj, "Bias": b,
+           "SeqLen": seq_len_var(input)}
+    if h_0 is not None:
+        ins["H0"] = h_0
+    if c_0 is not None:
+        ins["C0"] = c_0
+    helper.append_op("lstmp", inputs=ins,
+                     outputs={"Projection": proj, "Cell": cell},
+                     attrs={"use_peepholes": use_peepholes,
+                            "is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation,
+                            "proj_activation": proj_activation,
+                            "cell_clip": float(cell_clip or 0.0),
+                            "proj_clip": float(proj_clip or 0.0)})
+    helper.append_op("assign", inputs={"X": seq_len_var(input)},
+                     outputs={"Out": helper.block.var(
+                         proj.name + lod_suffix)})
+    return proj, cell
 
 
 def dynamic_gru(input, size, param_attr=None, bias_attr=None,

@@ -423,3 +423,176 @@ def _roi_pool(ctx, ins, attrs):
         return jnp.where(res == neg, 0.0, res)
 
     return {"Out": [jax.vmap(one)(rois, bidx.astype(jnp.int32))]}
+
+
+# ---------------------------------------------------------------------------
+# RPN / FPN proposal pipeline
+# ---------------------------------------------------------------------------
+
+
+def _decode_proposals(anchors, deltas, variances):
+    """bbox_deltas -> boxes around anchors (reference
+    detection/generate_proposals_op.cc BoxCoder path, variance-scaled)."""
+    wa = anchors[:, 2] - anchors[:, 0] + 1.0
+    ha = anchors[:, 3] - anchors[:, 1] + 1.0
+    cxa = anchors[:, 0] + 0.5 * wa
+    cya = anchors[:, 1] + 0.5 * ha
+    dx, dy, dw, dh = deltas[:, 0], deltas[:, 1], deltas[:, 2], deltas[:, 3]
+    if variances is not None:
+        dx = dx * variances[:, 0]
+        dy = dy * variances[:, 1]
+        dw = dw * variances[:, 2]
+        dh = dh * variances[:, 3]
+    # reference kBBoxClipDefault = log(1000/16): stop exp overflow
+    clip = jnp.log(1000.0 / 16.0)
+    cx = dx * wa + cxa
+    cy = dy * ha + cya
+    w = jnp.exp(jnp.minimum(dw, clip)) * wa
+    h = jnp.exp(jnp.minimum(dh, clip)) * ha
+    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                      cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0], axis=1)
+
+
+@register_op("generate_proposals",
+             inputs=[IOSpec("Scores", no_grad=True),
+                     IOSpec("BboxDeltas", no_grad=True),
+                     IOSpec("ImInfo", no_grad=True),
+                     IOSpec("Anchors", no_grad=True),
+                     IOSpec("Variances", optional=True, no_grad=True)],
+             outputs=["RpnRois", "RpnRoiProbs", "RpnRoisNum"],
+             attrs={"pre_nms_topN": 6000, "post_nms_topN": 1000,
+                    "nms_thresh": 0.5, "min_size": 0.1, "eta": 1.0},
+             grad=None)
+def _generate_proposals(ctx, ins, attrs):
+    """RPN proposal generation (reference
+    detection/generate_proposals_op.cc): decode deltas around anchors, clip
+    to image, drop boxes smaller than min_size (image-scale adjusted),
+    keep pre_nms_topN by score, NMS, keep post_nms_topN. The reference's
+    variable-length LoD output becomes fixed [N, post, 4] padded with -1 +
+    a RpnRoisNum lengths vector (the repo's LoD encoding). NMS cost is the
+    O(K^2) IoU matrix over K = min(pre_nms_topN, A*H*W) — keep pre_nms_topN
+    moderate on TPU."""
+    scores = x(ins, "Scores")            # [N, A, H, W]
+    deltas = x(ins, "BboxDeltas")        # [N, 4A, H, W]
+    im_info = x(ins, "ImInfo")           # [N, 3] (h, w, scale)
+    anchors = x(ins, "Anchors").reshape(-1, 4)
+    variances = x(ins, "Variances")
+    if variances is not None:
+        variances = variances.reshape(-1, 4)
+    n, a, h, w = scores.shape
+    k_all = a * h * w
+    pre_k = min(int(attrs["pre_nms_topN"]), k_all)
+    post_k = min(int(attrs["post_nms_topN"]), pre_k)
+    nms_thresh = float(attrs["nms_thresh"])
+    eta = float(attrs.get("eta", 1.0))
+    min_size = max(float(attrs["min_size"]), 1.0)
+
+    def per_image(sc, dl, info):
+        s_flat = sc.transpose(1, 2, 0).reshape(-1)           # H,W,A order
+        d_flat = dl.reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        boxes = _decode_proposals(anchors, d_flat, variances)
+        img_h, img_w, scale = info[0], info[1], info[2]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0.0, img_w - 1.0),
+            jnp.clip(boxes[:, 1], 0.0, img_h - 1.0),
+            jnp.clip(boxes[:, 2], 0.0, img_w - 1.0),
+            jnp.clip(boxes[:, 3], 0.0, img_h - 1.0)], axis=1)
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        ms = min_size * scale
+        valid = (ws >= ms) & (hs >= ms)
+        s_masked = jnp.where(valid, s_flat, -jnp.inf)
+        top = jnp.argsort(-s_masked)[:pre_k]
+        tb, ts = boxes[top], s_masked[top]
+        keep, order, sb, ss = _nms_class(
+            tb, ts, nms_thresh, -jnp.inf, post_k, eta, normalized=False)
+        rank = jnp.where(keep, jnp.cumsum(keep) - 1, post_k)
+        rois = jnp.full((post_k, 4), -1.0, boxes.dtype)
+        probs = jnp.zeros((post_k,), boxes.dtype)
+        rois = rois.at[rank].set(sb, mode="drop")
+        probs = probs.at[rank].set(ss, mode="drop")
+        count = jnp.minimum(jnp.sum(keep), post_k).astype(jnp.int32)
+        return rois, probs[:, None], count
+
+    rois, probs, counts = jax.vmap(per_image)(scores, deltas, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs],
+            "RpnRoisNum": [counts]}
+
+
+@register_op("distribute_fpn_proposals",
+             inputs=[IOSpec("FpnRois", no_grad=True),
+                     IOSpec("RoisNum", optional=True, no_grad=True)],
+             outputs=[IOSpec("MultiFpnRois", duplicable=True),
+                      IOSpec("MultiLevelRoIsNum", duplicable=True),
+                      IOSpec("RestoreIndex")],
+             attrs={"min_level": 2, "max_level": 5, "refer_level": 4,
+                    "refer_scale": 224, "pixel_offset": True}, grad=None)
+def _distribute_fpn_proposals(ctx, ins, attrs):
+    """Route each RoI to its FPN level (reference
+    detection/distribute_fpn_proposals_op.cc): level = floor(log2(
+    sqrt(area) / refer_scale)) + refer_level, clipped to [min, max].
+    Per-level outputs are [R, 4] front-compacted and -1 padded with a
+    lengths vector each; RestoreIndex is the permutation that rebuilds the
+    original order from the level-sorted concatenation."""
+    rois = x(ins, "FpnRois")             # [R, 4], -1-padded rows possible
+    r = rois.shape[0]
+    rois_num = x(ins, "RoisNum")
+    # padding rows (generate_proposals pads with -1 and reports RpnRoisNum)
+    # must reach NO level: they'd otherwise compute w=h=1 and flood min_level
+    valid = rois[:, 2] >= 0
+    if rois_num is not None:
+        valid = valid & (jnp.arange(r) < rois_num.reshape(-1).sum())
+    off = 1.0 if attrs.get("pixel_offset", True) else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    lo, hi = int(attrs["min_level"]), int(attrs["max_level"])
+    scale = jnp.sqrt(jnp.maximum(ws * hs, 1e-6))
+    lvl = jnp.floor(jnp.log2(scale / float(attrs["refer_scale"]) + 1e-12)) \
+        + int(attrs["refer_level"])
+    lvl = jnp.clip(lvl, lo, hi).astype(jnp.int32)
+    lvl = jnp.where(valid, lvl, hi + 1)         # overflow level: collected
+    #                                             by nothing, sorts last
+
+    order = jnp.argsort(lvl, stable=True)       # original idx, level-sorted
+    restore = jnp.argsort(order, stable=True).astype(jnp.int32)
+    restore = jnp.where(valid, restore, -1)
+
+    multi_rois, multi_num = [], []
+    for level in range(lo, hi + 1):
+        is_l = lvl == level
+        cnt = jnp.sum(is_l).astype(jnp.int32)
+        rank = jnp.where(is_l, jnp.cumsum(is_l) - 1, r)
+        out_l = jnp.full((r, 4), -1.0, rois.dtype).at[rank].set(
+            rois, mode="drop")
+        multi_rois.append(out_l)
+        multi_num.append(cnt.reshape((1,)))
+    return {"MultiFpnRois": multi_rois, "MultiLevelRoIsNum": multi_num,
+            "RestoreIndex": [restore[:, None]]}
+
+
+@register_op("collect_fpn_proposals",
+             inputs=[IOSpec("MultiLevelRois", duplicable=True, no_grad=True),
+                     IOSpec("MultiLevelScores", duplicable=True,
+                             no_grad=True),
+                     IOSpec("MultiLevelRoIsNum", duplicable=True,
+                             optional=True, no_grad=True)],
+             outputs=["FpnRois", "RoisNum"],
+             attrs={"post_nms_topN": 100}, grad=None)
+def _collect_fpn_proposals(ctx, ins, attrs):
+    """Merge per-level proposals and keep the post_nms_topN best by score
+    (reference detection/collect_fpn_proposals_op.cc). Padded rows
+    (negative coords) are treated as absent."""
+    rois_list = [v for v in ins.get("MultiLevelRois", []) if v is not None]
+    score_list = [v for v in ins.get("MultiLevelScores", []) if v is not None]
+    all_rois = jnp.concatenate(rois_list, axis=0)
+    all_scores = jnp.concatenate(
+        [s.reshape(-1) for s in score_list], axis=0)
+    valid = all_rois[:, 2] >= 0
+    masked = jnp.where(valid, all_scores, -jnp.inf)
+    k = min(int(attrs["post_nms_topN"]), all_rois.shape[0])
+    top = jnp.argsort(-masked)[:k]
+    sel = all_rois[top]
+    sel_valid = jnp.isfinite(masked[top])
+    sel = jnp.where(sel_valid[:, None], sel, -1.0)
+    count = jnp.sum(sel_valid).astype(jnp.int32)
+    return {"FpnRois": [sel], "RoisNum": [count.reshape((1,))]}

@@ -117,6 +117,84 @@ def _lstm(ctx, ins, attrs):
     return {"Hidden": [jnp.where(mask, hidden, 0)], "Cell": [cT]}
 
 
+@register_op("lstmp",
+             inputs=[IOSpec("Input"), IOSpec("Weight"),
+                     IOSpec("ProjWeight"), IOSpec("Bias", optional=True),
+                     IOSpec("H0", optional=True), IOSpec("C0", optional=True),
+                     IOSpec("SeqLen", no_grad=True)],
+             outputs=["Projection", "Cell"],
+             attrs={"use_peepholes": True, "is_reverse": False,
+                    "gate_activation": "sigmoid", "cell_activation": "tanh",
+                    "candidate_activation": "tanh",
+                    "proj_activation": "tanh", "cell_clip": 0.0,
+                    "proj_clip": 0.0})
+def _lstmp(ctx, ins, attrs):
+    """LSTM with recurrent projection (reference lstmp_op.h): the [B,P]
+    projection r = proj_act(h @ ProjWeight) is what recurs through
+    Weight [P,4H], shrinking the recurrent matmul from HxH to PxH —
+    the LSTMP of Sak et al. that the reference ships for speech."""
+    xg = x(ins, "Input")
+    w = x(ins, "Weight")                 # [P, 4H]
+    w_proj = x(ins, "ProjWeight")        # [H, P]
+    bias = x(ins, "Bias")
+    ln = x(ins, "SeqLen")
+    B, T, H4 = xg.shape
+    H = H4 // 4
+    P = w_proj.shape[1]
+    act_g = _ACT[attrs["gate_activation"]]
+    act_c = _ACT[attrs["cell_activation"]]
+    act_cand = _ACT[attrs["candidate_activation"]]
+    act_p = _ACT[attrs["proj_activation"]]
+    peep = attrs.get("use_peepholes", False) and bias is not None \
+        and bias.reshape(-1).shape[0] >= 7 * H
+    b = None if bias is None else bias.reshape(-1)
+    gate_b = None if b is None else b[:4 * H]
+    ckI = b[4 * H:5 * H] if peep else 0.0
+    ckF = b[5 * H:6 * H] if peep else 0.0
+    ckO = b[6 * H:7 * H] if peep else 0.0
+
+    h0 = x(ins, "H0")                    # [B, P] initial projection
+    c0 = x(ins, "C0")
+    r0 = jnp.zeros((B, P), xg.dtype) if h0 is None else h0
+    c0 = jnp.zeros((B, H), xg.dtype) if c0 is None else c0
+
+    xs = jnp.moveaxis(xg, 1, 0)
+    if attrs.get("is_reverse"):
+        t_idx = jnp.arange(T)[:, None]
+        src = jnp.where(t_idx < ln[None, :], ln[None, :] - 1 - t_idx, t_idx)
+        xs = jnp.take_along_axis(xs, src[:, :, None], axis=0)
+
+    cell_clip = attrs.get("cell_clip", 0.0)
+    proj_clip = attrs.get("proj_clip", 0.0)
+
+    def step(carry, xt):
+        r, c = carry
+        g = xt + r @ w
+        if gate_b is not None:
+            g = g + gate_b
+        cand = act_cand(g[:, :H])
+        i = act_g(g[:, H:2 * H] + c * ckI)
+        f = act_g(g[:, 2 * H:3 * H] + c * ckF)
+        new_c = cand * i + c * f
+        if cell_clip and cell_clip > 0:
+            new_c = jnp.clip(new_c, -cell_clip, cell_clip)
+        o = act_g(g[:, 3 * H:] + new_c * ckO)
+        new_h = o * act_c(new_c)
+        new_r = act_p(new_h @ w_proj)
+        if proj_clip and proj_clip > 0:
+            new_r = jnp.clip(new_r, -proj_clip, proj_clip)
+        return new_r, new_c
+
+    (rT, cT), (rs, _) = _scan_outputs(step, (r0, c0), xs, ln)
+    proj = jnp.moveaxis(rs, 0, 1)        # [B,T,P]
+    if attrs.get("is_reverse"):
+        t_idx = jnp.arange(T)[None, :]
+        src = jnp.where(t_idx < ln[:, None], ln[:, None] - 1 - t_idx, t_idx)
+        proj = jnp.take_along_axis(proj, src[:, :, None], axis=1)
+    mask = (jnp.arange(T)[None, :] < ln[:, None])[..., None]
+    return {"Projection": [jnp.where(mask, proj, 0)], "Cell": [cT]}
+
+
 @register_op("gru",
              inputs=[IOSpec("Input"), IOSpec("Weight"),
                      IOSpec("Bias", optional=True),

@@ -1,0 +1,495 @@
+"""Structured prediction + candidate sampling ops.
+
+Reference kernels, all CPU-loop based, re-derived as vectorized XLA programs:
+* linear_chain_crf / crf_decoding — operators/linear_chain_crf_op.h:172
+  (ForwardOneSequence: L1-normalized alpha recursion) and
+  operators/crf_decoding_op.h (Viterbi). Here the forward runs in log space
+  under ``lax.scan`` (the L1 trick exists to stop fp underflow in prob
+  space; logsumexp is the numerically-stable equivalent that also
+  differentiates cleanly, so the backward is the generic vjp instead of the
+  reference's hand-written forward-backward marginals).
+* nce — operators/nce_op.h (sampled logistic loss).
+* hierarchical_sigmoid — operators/hierarchical_sigmoid_op.h +
+  math/matrix_bit_code.h:105 SimpleCode (c = label + C; index(bit) =
+  (c >> (bit+1)) - 1; bit(bit) = c & (1 << bit)).
+* edit_distance — operators/edit_distance_op.h (Levenshtein DP); the
+  anti-diagonal inner dependency becomes a cummin prefix trick so each DP
+  row is one vectorized step.
+* ctc_align — operators/ctc_align_op.h (merge repeats, drop blanks).
+* chunk_eval — operators/chunk_eval_op.h (IOB/IOE/IOBES/plain chunk F1).
+
+Sequence inputs follow the repo's padded + ``@LOD`` lengths encoding
+(layers/sequence.py): ops take explicit length tensors.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import logsumexp
+
+from .common import IOSpec, out, register_op, x
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF
+# ---------------------------------------------------------------------------
+
+
+def _crf_parts(transition):
+    # reference layout (linear_chain_crf_op.h:187-189): row 0 start weights,
+    # row 1 end weights, rows 2.. the [D, D] transition matrix
+    return transition[0], transition[1], transition[2:]
+
+
+def _canon_label(label):
+    if label.ndim >= 3 and label.shape[-1] == 1:
+        label = jnp.squeeze(label, -1)
+    return label.astype(jnp.int32)
+
+
+@register_op("linear_chain_crf",
+             inputs=[IOSpec("Emission"), IOSpec("Transition"),
+                     IOSpec("Label", no_grad=True),
+                     IOSpec("Length", optional=True, no_grad=True)],
+             outputs=["Alpha", "EmissionExps", "TransitionExps",
+                      "LogLikelihood"])
+def _linear_chain_crf(ctx, ins, attrs):
+    """Per-sequence negative log-likelihood (a cost, like the reference:
+    ForwardOneSequence returns ``-ll``). Alpha is emitted in LOG space —
+    documented deviation from the reference's L1-normalized prob-space
+    alpha, which exists only as scratch for its hand-written backward."""
+    em, w = x(ins, "Emission"), x(ins, "Transition")
+    label = _canon_label(x(ins, "Label"))
+    b, t, d = em.shape
+    length = x(ins, "Length")
+    length = (jnp.full((b,), t, jnp.int32) if length is None
+              else length.reshape(-1).astype(jnp.int32))
+    start, end, trans = _crf_parts(w)
+    mask = jnp.arange(t)[None, :] < length[:, None]            # [B,T]
+
+    # numerator: score of the gold path
+    em_gold = jnp.take_along_axis(em, label[..., None], axis=2)[..., 0]
+    gold = jnp.sum(em_gold * mask, 1) + start[label[:, 0]]
+    if t > 1:
+        tr_gold = trans[label[:, :-1], label[:, 1:]]
+        gold = gold + jnp.sum(tr_gold * mask[:, 1:], 1)
+    last = jnp.clip(length - 1, 0, t - 1)
+    last_lbl = jnp.take_along_axis(label, last[:, None], 1)[:, 0]
+    gold = gold + end[last_lbl]
+
+    # denominator: log-partition via the alpha recursion
+    alpha0 = start[None, :] + em[:, 0]                          # [B,D]
+
+    def step(alpha, xs):
+        x_t, m_t = xs
+        nxt = logsumexp(alpha[:, :, None] + trans[None], axis=1) + x_t
+        nxt = jnp.where(m_t[:, None], nxt, alpha)
+        return nxt, nxt
+
+    if t > 1:
+        alpha_t, alphas = jax.lax.scan(
+            step, alpha0,
+            (em[:, 1:].transpose(1, 0, 2), mask[:, 1:].T))
+        alpha_full = jnp.concatenate(
+            [alpha0[:, None], alphas.transpose(1, 0, 2)], axis=1)
+    else:
+        alpha_t, alpha_full = alpha0, alpha0[:, None]
+    log_z = logsumexp(alpha_t + end[None, :], axis=1)
+
+    nll = (log_z - gold).reshape(b, 1)
+    row_max = jnp.max(em, axis=2, keepdims=True)
+    return {"Alpha": [alpha_full],
+            "EmissionExps": [jnp.exp(em - row_max)],
+            "TransitionExps": [jnp.exp(w)],
+            "LogLikelihood": [nll]}
+
+
+@register_op("crf_decoding",
+             inputs=[IOSpec("Emission", no_grad=True),
+                     IOSpec("Transition", no_grad=True),
+                     IOSpec("Label", optional=True, no_grad=True),
+                     IOSpec("Length", optional=True, no_grad=True)],
+             outputs=["ViterbiPath"], grad=None)
+def _crf_decoding(ctx, ins, attrs):
+    """Viterbi decode (reference crf_decoding_op.h). With Label given the
+    output is the 0/1 per-position correctness mask the reference emits
+    (consumed by chunk_eval-style evaluators)."""
+    em, w = x(ins, "Emission"), x(ins, "Transition")
+    b, t, d = em.shape
+    length = x(ins, "Length")
+    length = (jnp.full((b,), t, jnp.int32) if length is None
+              else length.reshape(-1).astype(jnp.int32))
+    start, end, trans = _crf_parts(w)
+    mask = jnp.arange(t)[None, :] < length[:, None]
+
+    delta0 = start[None, :] + em[:, 0]
+    ident = jnp.broadcast_to(jnp.arange(d)[None, :], (b, d))
+
+    def step(delta, xs):
+        x_t, m_t = xs
+        scores = delta[:, :, None] + trans[None]                # [B,from,to]
+        best = jnp.max(scores, axis=1) + x_t
+        bp = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        # padded steps: identity backpointers, frozen delta
+        bp = jnp.where(m_t[:, None], bp, ident)
+        nxt = jnp.where(m_t[:, None], best, delta)
+        return nxt, bp
+
+    if t > 1:
+        delta_t, bps = jax.lax.scan(
+            step, delta0, (em[:, 1:].transpose(1, 0, 2), mask[:, 1:].T))
+    else:
+        delta_t, bps = delta0, jnp.zeros((0, b, d), jnp.int32)
+    last_tag = jnp.argmax(delta_t + end[None, :], axis=1).astype(jnp.int32)
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], 1)[:, 0]
+        return prev, tag
+
+    first_tag, tags = jax.lax.scan(back, last_tag, bps, reverse=True)
+    if t > 1:
+        path = jnp.concatenate(
+            [first_tag[:, None], tags.transpose(1, 0)], axis=1)
+    else:
+        path = last_tag[:, None]
+    path = jnp.where(mask, path, 0).astype(jnp.int64)
+
+    label = x(ins, "Label")
+    if label is not None:
+        lbl = _canon_label(label)
+        return out(jnp.where(mask, (path == lbl).astype(jnp.int64), 0),
+                   "ViterbiPath")
+    return out(path, "ViterbiPath")
+
+
+# ---------------------------------------------------------------------------
+# NCE
+# ---------------------------------------------------------------------------
+
+
+def _log_uniform_probs(ids, vocab):
+    ids = ids.astype(jnp.float32)
+    return jnp.log((ids + 2.0) / (ids + 1.0)) / math.log(vocab + 1.0)
+
+
+def _nce_sample(key, sampler, shape, vocab):
+    if sampler == 1:  # log_uniform (Zipf), reference sampler.h LogUniform
+        u = jax.random.uniform(key, shape)
+        ids = jnp.exp(u * math.log(vocab + 1.0)) - 1.0
+        return jnp.clip(ids.astype(jnp.int32), 0, vocab - 1)
+    return jax.random.randint(key, shape, 0, vocab)
+
+
+@register_op("nce",
+             inputs=[IOSpec("Input"), IOSpec("Label", no_grad=True),
+                     IOSpec("Weight"), IOSpec("Bias", optional=True),
+                     IOSpec("SampleWeight", optional=True, no_grad=True)],
+             outputs=["Cost", "SampleLogits", "SampleLabels"],
+             attrs={"num_total_classes": 0, "num_neg_samples": 10,
+                    "sampler": 0, "seed": 0, "is_sparse": False,
+                    "remote_prefetch": False, "custom_neg_classes": []})
+def _nce(ctx, ins, attrs):
+    """Sampled logistic NCE loss (reference nce_op.h:96 forward): for true
+    classes o = sigmoid(s - log(k*q)), cost -= log(o); for k sampled
+    negatives cost -= log(1 - o). Sampling uses the op's folded PRNG key, so
+    the grad replay (generic vjp re-trace with the same uid) draws the SAME
+    negatives — the property the reference gets by seeding per-op."""
+    inp = x(ins, "Input")
+    label = x(ins, "Label").astype(jnp.int32)
+    if label.ndim == 1:
+        label = label[:, None]
+    weight, bias = x(ins, "Weight"), x(ins, "Bias")
+    b = inp.shape[0]
+    vocab = int(attrs["num_total_classes"])
+    k = int(attrs["num_neg_samples"])
+    sampler = int(attrs["sampler"])
+    if sampler == 2:
+        raise NotImplementedError(
+            "nce custom_dist sampling: pass sampler=0 (uniform) or 1 "
+            "(log_uniform); custom distributions need host-side alias "
+            "tables the XLA program cannot consume")
+    # explicit seed -> reproducible negatives across runs/programs (the
+    # contract sibling RNG ops honor); else the op's folded per-step key
+    key = (jax.random.key(int(attrs["seed"])) if attrs.get("seed")
+           else ctx.rng())
+    neg = _nce_sample(key, sampler, (b, k), vocab)
+    num_true = label.shape[1]
+    all_ids = jnp.concatenate([label, neg], axis=1)             # [B, nt+k]
+    w_rows = weight[all_ids]                                    # [B, nt+k, d]
+    logits = jnp.einsum("bd,bsd->bs", inp, w_rows)
+    if bias is not None:
+        logits = logits + bias[all_ids]
+    if sampler == 1:
+        q = _log_uniform_probs(all_ids, vocab)
+    else:
+        q = jnp.full(all_ids.shape, 1.0 / vocab)
+    adj = logits - jnp.log(k * q)
+    # stable log-sigmoid forms
+    log_sig = -jax.nn.softplus(-adj)                # log(sigmoid)
+    log_one_minus = -jax.nn.softplus(adj)           # log(1 - sigmoid)
+    cost = -(jnp.sum(log_sig[:, :num_true], 1)
+             + jnp.sum(log_one_minus[:, num_true:], 1))
+    sw = x(ins, "SampleWeight")
+    if sw is not None:
+        cost = cost * sw.reshape(-1)
+    return {"Cost": [cost.reshape(b, 1)], "SampleLogits": [logits],
+            "SampleLabels": [all_ids.astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid
+# ---------------------------------------------------------------------------
+
+
+@register_op("hierarchical_sigmoid",
+             inputs=[IOSpec("X"), IOSpec("W"), IOSpec("Label", no_grad=True),
+                     IOSpec("PathTable", optional=True, no_grad=True),
+                     IOSpec("PathCode", optional=True, no_grad=True),
+                     IOSpec("Bias", optional=True)],
+             outputs=["Out", "PreOut"],
+             attrs={"num_classes": 2, "is_sparse": False,
+                    "remote_prefetch": False})
+def _hierarchical_sigmoid(ctx, ins, attrs):
+    """Complete-binary-tree hsigmoid (reference hierarchical_sigmoid_op.h +
+    matrix_bit_code.h SimpleCode): heap code c = label + C, path node
+    index(j) = (c >> (j+1)) - 1, target bit(j) = (c >> j) & 1, walked for
+    floor(log2(c)) levels. Custom trees come in via PathTable/PathCode
+    ([B, L] node ids / bits, -1 padded). Loss is the summed sigmoid
+    cross-entropy along the path."""
+    inp, w = x(ins, "X"), x(ins, "W")
+    label = x(ins, "Label").reshape(-1).astype(jnp.int32)
+    bias = x(ins, "Bias")
+    path_table, path_code = x(ins, "PathTable"), x(ins, "PathCode")
+    b = inp.shape[0]
+    if path_table is not None:
+        idx = path_table.astype(jnp.int32)                      # [B, L]
+        bits = path_code.astype(jnp.float32)
+        valid = idx >= 0
+        idx = jnp.maximum(idx, 0)
+    else:
+        c = label + int(attrs["num_classes"])                   # heap code
+        max_len = max(int(math.ceil(math.log2(int(attrs["num_classes"])))), 1)
+        j = jnp.arange(max_len)[None, :]
+        # code length = floor(log2(c)); bits walked right-to-left
+        length = jnp.floor(jnp.log2(c.astype(jnp.float32))).astype(jnp.int32)
+        valid = j < length[:, None]
+        idx = jnp.where(valid, (c[:, None] >> (j + 1)) - 1, 0)
+        bits = ((c[:, None] >> j) & 1).astype(jnp.float32)
+    pre = jnp.einsum("bd,bld->bl", inp, w[idx])                 # [B, L]
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[idx]
+    # sigmoid CE with logits z vs target t: softplus(z) - z*t
+    ce = jax.nn.softplus(pre) - pre * bits
+    cost = jnp.sum(jnp.where(valid, ce, 0.0), axis=1)
+    return {"Out": [cost.reshape(b, 1)],
+            "PreOut": [jnp.where(valid, pre, 0.0)]}
+
+
+# ---------------------------------------------------------------------------
+# edit distance
+# ---------------------------------------------------------------------------
+
+
+@register_op("edit_distance",
+             inputs=[IOSpec("Hyps", no_grad=True),
+                     IOSpec("Refs", no_grad=True),
+                     IOSpec("HypsLength", optional=True, no_grad=True),
+                     IOSpec("RefsLength", optional=True, no_grad=True)],
+             outputs=["Out", "SequenceNum"],
+             attrs={"normalized": False}, grad=None)
+def _edit_distance(ctx, ins, attrs):
+    """Levenshtein distance per sequence pair (reference
+    edit_distance_op.h). The classic DP row update has a serial dependency
+    through new_row[j-1]; it decomposes as a cummin over (candidate[j] - j)
+    so every row is one vectorized step under lax.scan."""
+    hyp = x(ins, "Hyps")
+    ref = x(ins, "Refs")
+    if hyp.ndim == 3 and hyp.shape[-1] == 1:
+        hyp = jnp.squeeze(hyp, -1)
+    if ref.ndim == 3 and ref.shape[-1] == 1:
+        ref = jnp.squeeze(ref, -1)
+    b, th = hyp.shape
+    tr = ref.shape[1]
+    hlen = x(ins, "HypsLength")
+    rlen = x(ins, "RefsLength")
+    hlen = (jnp.full((b,), th, jnp.int32) if hlen is None
+            else hlen.reshape(-1).astype(jnp.int32))
+    rlen = (jnp.full((b,), tr, jnp.int32) if rlen is None
+            else rlen.reshape(-1).astype(jnp.int32))
+    ref_mask = jnp.arange(tr)[None, :] < rlen[:, None]
+    row0 = jnp.concatenate(
+        [jnp.zeros((b, 1)), jnp.where(ref_mask, 1.0, 0.0).cumsum(1)], axis=1)
+
+    def step(row, xs):
+        h_t, active = xs                                        # [B], [B]
+        sub = (h_t[:, None] != ref).astype(jnp.float32)
+        cand = jnp.minimum(row[:, 1:] + 1.0, row[:, :-1] + sub)  # [B, tr]
+        first = row[:, :1] + 1.0                                # j = 0
+        m = jnp.concatenate([first, cand], axis=1) - jnp.arange(tr + 1)[None]
+        new_row = jax.lax.associative_scan(jnp.minimum, m, axis=1) \
+            + jnp.arange(tr + 1)[None]
+        new_row = jnp.where(active[:, None], new_row, row)
+        return new_row, None
+
+    active = jnp.arange(th)[None, :] < hlen[:, None]
+    final_row, _ = jax.lax.scan(step, row0, (hyp.T, active.T))
+    dist = jnp.take_along_axis(final_row, rlen[:, None], axis=1)[:, 0]
+    # reference: empty ref -> distance = hyp length
+    dist = jnp.where(rlen == 0, hlen.astype(dist.dtype), dist)
+    if attrs.get("normalized"):
+        dist = dist / jnp.maximum(rlen.astype(dist.dtype), 1.0)
+    return {"Out": [dist.reshape(b, 1).astype(jnp.float32)],
+            "SequenceNum": [jnp.array([b], jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# ctc_align
+# ---------------------------------------------------------------------------
+
+
+@register_op("ctc_align",
+             inputs=[IOSpec("Input", no_grad=True),
+                     IOSpec("InputLength", optional=True, no_grad=True)],
+             outputs=["Output", "OutputLength"],
+             attrs={"blank": 0, "merge_repeated": True, "padding_value": 0},
+             grad=None)
+def _ctc_align(ctx, ins, attrs):
+    """CTC alignment (reference ctc_align_op.h): merge repeats, drop
+    blanks. Output is padded + per-sequence lengths (the repo's LoD
+    encoding of the reference's variable-length LoDTensor output)."""
+    inp = x(ins, "Input")
+    if inp.ndim == 3 and inp.shape[-1] == 1:
+        inp = jnp.squeeze(inp, -1)
+    b, t = inp.shape
+    ilen = x(ins, "InputLength")
+    ilen = (jnp.full((b,), t, jnp.int32) if ilen is None
+            else ilen.reshape(-1).astype(jnp.int32))
+    blank = int(attrs["blank"])
+    pad_val = int(attrs.get("padding_value", 0))
+    in_range = jnp.arange(t)[None, :] < ilen[:, None]
+    keep = (inp != blank) & in_range
+    if attrs.get("merge_repeated", True):
+        prev = jnp.concatenate(
+            [jnp.full((b, 1), -1, inp.dtype), inp[:, :-1]], axis=1)
+        keep = keep & ((inp != prev) | ~jnp.concatenate(
+            [jnp.zeros((b, 1), bool), in_range[:, :-1]], axis=1))
+    pos = jnp.cumsum(keep, axis=1) - 1                          # target slot
+    pos = jnp.where(keep, pos, t)                               # drop -> OOB
+    outp = jnp.full((b, t), pad_val, inp.dtype)
+    bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+    outp = outp.at[bidx, pos].set(inp, mode="drop")
+    out_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return {"Output": [outp.astype(jnp.int64)], "OutputLength": [out_len]}
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval
+# ---------------------------------------------------------------------------
+
+_CHUNK_SCHEMES = {"plain": 0, "IOB": 2, "IOE": 2, "IOBES": 4}
+
+
+def _chunk_marks(tags, scheme, num_types, seq_mask):
+    """(is_begin, is_end, type) per position for a tag sequence under the
+    given scheme — vectorized restatement of reference chunk_eval_op.h
+    Segment extraction (GetSegments)."""
+    n_tag = _CHUNK_SCHEMES[scheme]
+    if scheme == "plain":
+        ctype = tags
+        inside = (tags >= 0) & (tags < num_types) & seq_mask
+        tag_kind = None
+    else:
+        ctype = tags // n_tag
+        tag_kind = tags % n_tag
+        inside = (ctype < num_types) & (tags >= 0) & seq_mask
+    prev_inside = jnp.pad(inside[:, :-1], ((0, 0), (1, 0)))
+    prev_type = jnp.pad(ctype[:, :-1], ((0, 0), (1, 0)),
+                        constant_values=-1)
+    next_inside = jnp.pad(inside[:, 1:], ((0, 0), (0, 1)))
+    next_type = jnp.pad(ctype[:, 1:], ((0, 0), (0, 1)),
+                        constant_values=-1)
+    same_prev = prev_inside & (prev_type == ctype)
+    same_next = next_inside & (next_type == ctype)
+    if scheme == "plain":
+        begin = inside & ~same_prev
+        end = inside & ~same_next
+    elif scheme == "IOB":                     # B=0, I=1
+        is_b = tag_kind == 0
+        begin = inside & (is_b | ~same_prev)
+        nxt_kind = jnp.pad(tag_kind[:, 1:], ((0, 0), (0, 1)),
+                           constant_values=0)
+        end = inside & (~same_next | (nxt_kind == 0))
+    elif scheme == "IOE":                     # I=0, E=1
+        is_e = tag_kind == 1
+        prev_kind = jnp.pad(tag_kind[:, :-1], ((0, 0), (1, 0)),
+                            constant_values=1)
+        begin = inside & (~same_prev | (prev_kind == 1))
+        end = inside & (is_e | ~same_next)
+    else:                                     # IOBES: B=0,I=1,E=2,S=3
+        kind = tag_kind
+        begin = inside & ((kind == 0) | (kind == 3))
+        end = inside & ((kind == 2) | (kind == 3))
+    return begin, end, ctype
+
+
+def _next_end_pos(end, t):
+    """pos[i] = index of the first end >= i (t when none)."""
+    idx = jnp.where(end, jnp.arange(t)[None, :], t)
+    return jax.lax.associative_scan(jnp.minimum, idx, reverse=True, axis=1)
+
+
+@register_op("chunk_eval",
+             inputs=[IOSpec("Inference", no_grad=True),
+                     IOSpec("Label", no_grad=True),
+                     IOSpec("SeqLength", optional=True, no_grad=True)],
+             outputs=["Precision", "Recall", "F1-Score", "NumInferChunks",
+                      "NumLabelChunks", "NumCorrectChunks"],
+             attrs={"num_chunk_types": 1, "chunk_scheme": "IOB",
+                    "excluded_chunk_types": []}, grad=None)
+def _chunk_eval(ctx, ins, attrs):
+    inf = x(ins, "Inference")
+    lab = x(ins, "Label")
+    if inf.ndim == 3 and inf.shape[-1] == 1:
+        inf = jnp.squeeze(inf, -1)
+    if lab.ndim == 3 and lab.shape[-1] == 1:
+        lab = jnp.squeeze(lab, -1)
+    inf = inf.astype(jnp.int32)
+    lab = lab.astype(jnp.int32)
+    b, t = inf.shape
+    slen = x(ins, "SeqLength")
+    slen = (jnp.full((b,), t, jnp.int32) if slen is None
+            else slen.reshape(-1).astype(jnp.int32))
+    seq_mask = jnp.arange(t)[None, :] < slen[:, None]
+    scheme = attrs.get("chunk_scheme", "IOB")
+    num_types = int(attrs["num_chunk_types"])
+    excluded = list(attrs.get("excluded_chunk_types") or [])
+
+    ib, ie, it = _chunk_marks(inf, scheme, num_types, seq_mask)
+    lb, le, lt = _chunk_marks(lab, scheme, num_types, seq_mask)
+
+    def _not_excluded(ctype):
+        ok = jnp.ones(ctype.shape, bool)
+        for e in excluded:
+            ok = ok & (ctype != e)
+        return ok
+
+    n_inf = jnp.sum(ib & _not_excluded(it))
+    n_lab = jnp.sum(lb & _not_excluded(lt))
+    # a chunk is correct iff both sequences start a chunk at i with the
+    # same type and both chunks end at the same position
+    correct = (ib & lb & (it == lt) & _not_excluded(it)
+               & (_next_end_pos(ie, t) == _next_end_pos(le, t)))
+    n_correct = jnp.sum(correct)
+
+    prec = jnp.where(n_inf > 0, n_correct / n_inf, 0.0).astype(jnp.float32)
+    rec = jnp.where(n_lab > 0, n_correct / n_lab, 0.0).astype(jnp.float32)
+    f1 = jnp.where(prec + rec > 0, 2 * prec * rec / (prec + rec),
+                   0.0).astype(jnp.float32)
+    as1 = lambda v, dt: jnp.asarray(v, dt).reshape((1,))
+    return {"Precision": [as1(prec, jnp.float32)],
+            "Recall": [as1(rec, jnp.float32)],
+            "F1-Score": [as1(f1, jnp.float32)],
+            "NumInferChunks": [as1(n_inf, jnp.int64)],
+            "NumLabelChunks": [as1(n_lab, jnp.int64)],
+            "NumCorrectChunks": [as1(n_correct, jnp.int64)]}

@@ -1,0 +1,248 @@
+"""Book-style end-to-end gates, round 5 additions (reference
+python/paddle/fluid/tests/book/): image_classification,
+recommender_system, label_semantic_roles (CRF), rnn_encoder_decoder.
+Each is the reference model's shape scaled to CPU-test size, fed through
+the DataFeeder/DataLoader, and judged on learning (loss drop / accuracy),
+mirroring the reference tests' convergence gates."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.unique_name as un
+from paddle_tpu.dataset import cifar, conll05, movielens, wmt16
+
+
+def test_image_classification():
+    """reference book/test_image_classification.py: conv net on cifar10."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", shape=[3072], dtype="float32")
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            img_nchw = fluid.layers.reshape(img, [-1, 3, 32, 32])
+            c1 = fluid.layers.conv2d(img_nchw, 16, 3, padding=1, act="relu")
+            p1 = fluid.layers.pool2d(c1, 2, "max", 2)
+            b1 = fluid.layers.batch_norm(p1)
+            c2 = fluid.layers.conv2d(b1, 32, 3, padding=1, act="relu")
+            p2 = fluid.layers.pool2d(c2, 2, "max", 2)
+            flat = fluid.layers.flatten(p2)
+            h = fluid.layers.fc(flat, 64, act="relu")
+            logits = fluid.layers.fc(h, 10)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            acc = fluid.layers.accuracy(logits, label)
+            test_prog = main.clone(for_test=True)
+            fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+    main.random_seed = 5
+
+    loader = fluid.DataLoader.from_generator(feed_list=[img, label],
+                                             capacity=4)
+    loader.set_sample_generator(cifar.train10(), batch_size=64,
+                                drop_last=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for epoch in range(2):
+            for batch in loader:
+                (lv,) = exe.run(main, feed=batch, fetch_list=[loss.name])
+                losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        feeder = fluid.DataFeeder(feed_list=[img, label], program=main)
+        samples = [(im, np.array([lb])) for im, lb in
+                   list(cifar.test10()())[:256]]
+        (accv,) = exe.run(test_prog, feed=feeder.feed(samples),
+                          fetch_list=[acc.name])
+    assert losses[-1] < 0.7 * losses[0], (losses[0], losses[-1])
+    assert float(np.asarray(accv)) > 0.3, float(np.asarray(accv))
+
+
+def test_recommender_system():
+    """reference book/test_recommender_system.py: dual-tower user/movie
+    embeddings -> cos_sim -> scaled rating regression on movielens."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            uid = fluid.layers.data("user_id", shape=[1], dtype="int64")
+            gender = fluid.layers.data("gender_id", shape=[1], dtype="int64")
+            age = fluid.layers.data("age_id", shape=[1], dtype="int64")
+            job = fluid.layers.data("job_id", shape=[1], dtype="int64")
+            mid = fluid.layers.data("movie_id", shape=[1], dtype="int64")
+            cat = fluid.layers.data("category_id", shape=[2], dtype="int64")
+            score = fluid.layers.data("score", shape=[1], dtype="float32")
+
+            def tower(feats, sizes, dim=16):
+                parts = []
+                for f, n in zip(feats, sizes):
+                    e = fluid.layers.embedding(f, size=[n + 1, dim])
+                    parts.append(fluid.layers.reshape(e, [-1, dim]))
+                return fluid.layers.fc(fluid.layers.concat(parts, axis=1),
+                                       32, act="tanh")
+
+            usr = tower([uid, gender, age, job],
+                        [movielens.max_user_id(), 2,
+                         len(movielens.age_table),
+                         movielens.max_job_id()])
+            cat_emb = fluid.layers.embedding(
+                cat, size=[movielens.categories_dict_size() + 1, 16])
+            cat_vec = fluid.layers.reduce_mean(cat_emb, dim=1)
+            mov_id_emb = fluid.layers.embedding(
+                mid, size=[movielens.max_movie_id() + 1, 16])
+            mov = fluid.layers.fc(
+                fluid.layers.concat(
+                    [fluid.layers.reshape(mov_id_emb, [-1, 16]), cat_vec],
+                    axis=1), 32, act="tanh")
+            sim = fluid.layers.cos_sim(usr, mov)
+            pred = fluid.layers.scale(sim, scale=5.0)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, score))
+            fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+    main.random_seed = 6
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        batch, feed = 128, {}
+        gen = movielens.train()()
+        for step in range(60):
+            rows = [next(gen) for _ in range(batch)]
+            feed = {
+                "user_id": np.array([[r[0]] for r in rows], np.int64),
+                "gender_id": np.array([[r[1]] for r in rows], np.int64),
+                "age_id": np.array([[r[2]] for r in rows], np.int64),
+                "job_id": np.array([[r[3]] for r in rows], np.int64),
+                "movie_id": np.array([[r[4]] for r in rows], np.int64),
+                "category_id": np.stack([r[5] for r in rows]),
+                "score": np.array([[r[7]] for r in rows], np.float32),
+            }
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
+
+
+def test_label_semantic_roles():
+    """reference book/test_label_semantic_roles.py: the CRF gate — word +
+    mark embeddings -> bi-LSTM -> linear_chain_crf; decode with
+    crf_decoding, score with chunk_eval."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            word = fluid.layers.data("word", shape=[1], dtype="int64",
+                                     lod_level=1)
+            mark = fluid.layers.data("mark", shape=[1], dtype="int64",
+                                     lod_level=1)
+            target = fluid.layers.data("target", shape=[1], dtype="int64",
+                                       lod_level=1)
+            w_emb = fluid.layers.embedding(
+                word, size=[conll05.word_dict_len(), 32])
+            m_emb = fluid.layers.embedding(mark, size=[2, 8])
+            feat = fluid.layers.concat([w_emb, m_emb], axis=2)
+            gates = fluid.layers.fc(feat, 4 * 32, num_flatten_dims=2)
+            fwd, _ = fluid.layers.dynamic_lstm(gates, size=4 * 32)
+            rev_gates = fluid.layers.fc(feat, 4 * 32, num_flatten_dims=2)
+            rev, _ = fluid.layers.dynamic_lstm(rev_gates, size=4 * 32,
+                                               is_reverse=True)
+            both = fluid.layers.concat([fwd, rev], axis=2)
+            emission = fluid.layers.fc(
+                both, conll05.label_dict_len(), num_flatten_dims=2)
+            crf_cost = fluid.layers.linear_chain_crf(
+                input=emission, label=target,
+                param_attr=fluid.ParamAttr(name="crfw"),
+                length=fluid.layers.sequence.seq_len_var(word))
+            loss = fluid.layers.mean(crf_cost)
+            fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+            decode = fluid.layers.crf_decoding(
+                input=emission, param_attr=fluid.ParamAttr(name="crfw"),
+                length=fluid.layers.sequence.seq_len_var(word))
+            (prec, rec, f1, _, _, _) = fluid.layers.chunk_eval(
+                decode, target, chunk_scheme="IOB",
+                num_chunk_types=conll05.num_chunk_types(),
+                seq_length=fluid.layers.sequence.seq_len_var(word))
+    main.random_seed = 7
+
+    feeder = fluid.DataFeeder(feed_list=[word, mark, target], program=main)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    losses, f1s = [], []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        gen = conll05.train()()
+        for step in range(120):
+            rows = [next(gen) for _ in range(32)]
+            samples = [(w[:, None], m[:, None], t[:, None])
+                       for (w, p, m, t) in rows]
+            feed = feeder.feed(samples)
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        f1v = exe.run(main, feed=feed, fetch_list=[f1.name])[0]
+        f1s.append(float(np.asarray(f1v).reshape(-1)[0]))
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+    assert f1s[-1] > 0.9, f1s
+
+
+def test_rnn_encoder_decoder():
+    """reference book/test_rnn_encoder_decoder.py: GRU encoder, GRU
+    decoder conditioned on the encoder's final state, teacher-forced
+    cross-entropy on the synthetic wmt16 word-mapping task."""
+    vocab, emb_dim, hid = 130, 32, 64
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            src = fluid.layers.data("src", shape=[1], dtype="int64",
+                                    lod_level=1)
+            trg = fluid.layers.data("trg", shape=[1], dtype="int64",
+                                    lod_level=1)
+            trg_next = fluid.layers.data("trg_next", shape=[1],
+                                         dtype="int64", lod_level=1)
+            s_emb = fluid.layers.embedding(src, size=[vocab, emb_dim])
+            s_gates = fluid.layers.fc(s_emb, 3 * hid, num_flatten_dims=2)
+            enc = fluid.layers.dynamic_gru(s_gates, size=hid)
+            enc_last = fluid.layers.sequence_last_step(enc)
+
+            t_emb = fluid.layers.embedding(trg, size=[vocab, emb_dim])
+            t_gates = fluid.layers.fc(t_emb, 3 * hid, num_flatten_dims=2)
+            dec = fluid.layers.dynamic_gru(t_gates, size=hid,
+                                           h_0=enc_last)
+            logits = fluid.layers.fc(dec, vocab, num_flatten_dims=2)
+            ce = fluid.layers.softmax_with_cross_entropy(logits, trg_next)
+            from paddle_tpu.layers.sequence import seq_len_var
+
+            t_max = 9  # wmt16 synthetic: src <= 8, trg = src + BOS
+            mask = fluid.layers.cast(
+                fluid.layers.sequence_mask(seq_len_var(trg), maxlen=t_max),
+                "float32")
+            loss = fluid.layers.reduce_sum(
+                fluid.layers.squeeze(ce, axes=[2]) * mask) / (
+                fluid.layers.reduce_sum(mask) + 1e-6)
+            fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+    main.random_seed = 8
+
+    def pad_to(a, n):
+        return np.pad(a, (0, n - len(a)))
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        gen = wmt16.train()()
+        for step in range(100):
+            rows = [next(gen) for _ in range(64)]
+            feed = {
+                "src": np.stack([pad_to(s, 8) for s, t, n in rows])[..., None],
+                "src@LOD": np.array([len(s) for s, t, n in rows], np.int32),
+                "trg": np.stack([pad_to(t, 9) for s, t, n in rows])[..., None],
+                "trg@LOD": np.array([len(t) for s, t, n in rows], np.int32),
+                "trg_next": np.stack(
+                    [pad_to(n, 9) for s, t, n in rows])[..., None],
+                "trg_next@LOD": np.array([len(n) for s, t, n in rows],
+                                         np.int32),
+            }
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    # the mapping is deterministic: teacher-forced CE must fall well below
+    # uniform log(vocab) ~ 4.87
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+    assert losses[-1] < 2.5, losses[-1]

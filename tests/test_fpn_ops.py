@@ -1,0 +1,175 @@
+"""RPN/FPN proposal pipeline + lstmp OpTests (reference
+detection/generate_proposals_op.cc, distribute_fpn_proposals_op.cc,
+collect_fpn_proposals_op.cc, lstmp_op.h) against numpy oracles."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+RNG = np.random.RandomState(11)
+
+
+def _run_op(op_type, inputs, outputs_spec, attrs):
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        blk = fluid.default_main_program().global_block
+        in_map, feed = {}, {}
+        for slot, v in inputs.items():
+            arrs = v if isinstance(v, list) else [(slot.lower(), v)]
+            vs = []
+            for name, arr in arrs:
+                dt = {"float32": "float32", "int64": "int64",
+                      "int32": "int32"}[str(arr.dtype)]
+                vs.append(blk.create_var(name=name, shape=arr.shape,
+                                         dtype=dt, is_data=True))
+                feed[name] = arr
+            in_map[slot] = vs if isinstance(v, list) else vs[0]
+        out_map, fetch = {}, []
+        for slot, n_or_list in outputs_spec.items():
+            if isinstance(n_or_list, int):
+                vs = [blk.create_var(name=f"{slot}_{i}", shape=(1,),
+                                     dtype="float32")
+                      for i in range(n_or_list)]
+                out_map[slot] = vs
+                fetch += [v.name for v in vs]
+            else:
+                v = blk.create_var(name=slot.lower() + "_out", shape=(1,),
+                                   dtype="float32")
+                out_map[slot] = v
+                fetch.append(v.name)
+        blk.append_op(op_type, inputs=in_map, outputs=out_map, attrs=attrs)
+        exe = fluid.Executor(fluid.CPUPlace())
+        res = exe.run(fluid.default_main_program(), feed=feed,
+                      fetch_list=fetch)
+    return dict(zip(fetch, [np.asarray(r) for r in res]))
+
+
+def test_distribute_fpn_proposals():
+    # areas chosen to land on known levels: sqrt(area)/224 -> log2
+    sizes = [32, 64, 112, 224, 448, 500]
+    rois = np.array([[0, 0, s - 1, s - 1] for s in sizes], np.float32)
+    res = _run_op(
+        "distribute_fpn_proposals", {"FpnRois": rois},
+        {"MultiFpnRois": 4, "MultiLevelRoIsNum": 4, "RestoreIndex": None},
+        {"min_level": 2, "max_level": 5, "refer_level": 4,
+         "refer_scale": 224})
+    # level = clip(floor(log2(s/224)) + 4, 2, 5):
+    # 32->2(floor(-2.8)=-3 clip), 64->2(floor(-1.8)=-2), 112->3, 224->4,
+    # 448->5, 500->5
+    counts = [int(res[f"MultiLevelRoIsNum_{i}"].reshape(-1)[0])
+              for i in range(4)]
+    assert counts == [2, 1, 1, 2], counts
+    lvl2 = res["MultiFpnRois_0"]
+    np.testing.assert_allclose(lvl2[:2], rois[:2])
+    assert (lvl2[2:] == -1).all()
+    # restore index inverts the level-sort
+    restore = res["restoreindex_out"].reshape(-1)
+    level_sorted = np.concatenate(
+        [res[f"MultiFpnRois_{i}"][:counts[i]] for i in range(4)])
+    np.testing.assert_allclose(level_sorted[restore], rois)
+
+
+def test_distribute_fpn_proposals_ignores_padding():
+    """r5 review finding: -1-padded rows (generate_proposals' padding) must
+    reach NO level and get RestoreIndex = -1."""
+    rois = np.array([[0, 0, 223, 223],
+                     [-1, -1, -1, -1],
+                     [-1, -1, -1, -1]], np.float32)
+    res = _run_op(
+        "distribute_fpn_proposals", {"FpnRois": rois},
+        {"MultiFpnRois": 4, "MultiLevelRoIsNum": 4, "RestoreIndex": None},
+        {"min_level": 2, "max_level": 5, "refer_level": 4,
+         "refer_scale": 224})
+    counts = [int(res[f"MultiLevelRoIsNum_{i}"].reshape(-1)[0])
+              for i in range(4)]
+    assert counts == [0, 0, 1, 0], counts
+    restore = res["restoreindex_out"].reshape(-1)
+    assert restore[0] == 0 and (restore[1:] == -1).all()
+
+
+def test_collect_fpn_proposals():
+    r1 = np.array([[0, 0, 10, 10], [1, 1, 5, 5], [-1, -1, -1, -1]],
+                  np.float32)
+    r2 = np.array([[2, 2, 8, 8], [-1, -1, -1, -1], [-1, -1, -1, -1]],
+                  np.float32)
+    s1 = np.array([0.9, 0.2, 0.0], np.float32)
+    s2 = np.array([0.7, 0.0, 0.0], np.float32)
+    res = _run_op(
+        "collect_fpn_proposals",
+        {"MultiLevelRois": [("mr0", r1), ("mr1", r2)],
+         "MultiLevelScores": [("ms0", s1), ("ms1", s2)]},
+        {"FpnRois": None, "RoisNum": None}, {"post_nms_topN": 2})
+    got = res["fpnrois_out"]
+    np.testing.assert_allclose(got[0], [0, 0, 10, 10])
+    np.testing.assert_allclose(got[1], [2, 2, 8, 8])
+    assert int(res["roisnum_out"].reshape(-1)[0]) == 2
+
+
+def test_generate_proposals_shapes_and_ordering():
+    n, a, h, w = 2, 3, 4, 4
+    scores = RNG.rand(n, a, h, w).astype(np.float32)
+    deltas = (0.1 * RNG.randn(n, 4 * a, h, w)).astype(np.float32)
+    im_info = np.array([[64, 64, 1.0], [64, 64, 1.0]], np.float32)
+    anchors = np.zeros((h, w, a, 4), np.float32)
+    for yy in range(h):
+        for xx in range(w):
+            for ai in range(a):
+                cx, cy = xx * 16 + 8, yy * 16 + 8
+                sz = 8 * (ai + 1)
+                anchors[yy, xx, ai] = [cx - sz, cy - sz, cx + sz, cy + sz]
+    var = np.full((h, w, a, 4), 1.0, np.float32)
+    res = _run_op(
+        "generate_proposals",
+        {"Scores": scores, "BboxDeltas": deltas, "ImInfo": im_info,
+         "Anchors": anchors, "Variances": var},
+        {"RpnRois": None, "RpnRoiProbs": None, "RpnRoisNum": None},
+        {"pre_nms_topN": 24, "post_nms_topN": 8, "nms_thresh": 0.7,
+         "min_size": 2.0, "eta": 1.0})
+    rois = res["rpnrois_out"]
+    probs = res["rpnroiprobs_out"]
+    counts = res["rpnroisnum_out"].reshape(-1)
+    assert rois.shape == (n, 8, 4) and probs.shape == (n, 8, 1)
+    for i in range(n):
+        c = int(counts[i])
+        assert 1 <= c <= 8
+        valid = rois[i, :c]
+        # clipped to image, min-size respected, probs sorted descending
+        assert (valid[:, 0] >= 0).all() and (valid[:, 2] <= 63).all()
+        assert ((valid[:, 2] - valid[:, 0] + 1) >= 2).all()
+        p = probs[i, :c, 0]
+        assert (np.diff(p) <= 1e-6).all()
+        assert (rois[i, c:] == -1).all()
+
+
+def test_dynamic_lstmp_layer():
+    """lstmp: projection output has proj_size channels, grads flow, and a
+    tiny fit improves the loss."""
+    b, t, d, hidden, proj = 4, 5, 6, 8, 3
+    rng = np.random.RandomState(0)
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xv = fluid.layers.data(name="x", shape=[d], dtype="float32",
+                               lod_level=1)
+        y = fluid.layers.data(name="y", shape=[proj], dtype="float32")
+        gates = fluid.layers.fc(input=xv, size=4 * hidden,
+                                num_flatten_dims=2)
+        proj_out, cell = fluid.layers.dynamic_lstmp(
+            input=gates, size=4 * hidden, proj_size=proj)
+        last = fluid.layers.sequence_last_step(proj_out)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(last, y))
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        feed = {"x": rng.randn(b, t, d).astype(np.float32),
+                "x@LOD": np.array([5, 3, 5, 2], np.int32),
+                "y": rng.rand(b, proj).astype(np.float32)}
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            vals = []
+            for _ in range(40):
+                o = exe.run(fluid.default_main_program(), feed=feed,
+                            fetch_list=[loss, proj_out])
+                vals.append(float(np.asarray(o[0]).reshape(-1)[0]))
+            p = np.asarray(o[1])
+    assert p.shape == (b, t, proj)
+    # padded steps zeroed
+    assert (p[1, 3:] == 0).all() and (p[3, 2:] == 0).all()
+    assert vals[-1] < 0.5 * vals[0], (vals[0], vals[-1])

@@ -1,0 +1,335 @@
+"""OpTests for the structured-prediction op family (ops/structured.py):
+linear_chain_crf, crf_decoding, nce, hierarchical_sigmoid, edit_distance,
+ctc_align, chunk_eval — each against an independent numpy oracle
+implementing the reference kernel semantics (linear_chain_crf_op.h:172,
+crf_decoding_op.h, nce_op.h, hierarchical_sigmoid_op.h +
+matrix_bit_code.h SimpleCode, edit_distance_op.h, ctc_align_op.h,
+chunk_eval_op.h)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+RNG = np.random.RandomState(7)
+
+
+# -- numpy oracles ----------------------------------------------------------
+
+def np_crf_nll(em, w, label, length):
+    """Reference ForwardOneSequence in log space; returns -(gold - logZ)."""
+    b, t, d = em.shape
+    start, end, trans = w[0], w[1], w[2:]
+    out = np.zeros((b, 1), np.float64)
+    for i in range(b):
+        L = int(length[i])
+        x, y = em[i, :L].astype(np.float64), label[i, :L]
+        gold = start[y[0]] + x[np.arange(L), y].sum() + end[y[L - 1]]
+        for k in range(1, L):
+            gold += trans[y[k - 1], y[k]]
+        alpha = start + x[0]
+        for k in range(1, L):
+            alpha = np.array([
+                np.logaddexp.reduce(alpha + trans[:, j]) + x[k, j]
+                for j in range(d)])
+        logz = np.logaddexp.reduce(alpha + end)
+        out[i, 0] = logz - gold
+    return out
+
+
+def np_viterbi(em, w, length):
+    b, t, d = em.shape
+    start, end, trans = w[0], w[1], w[2:]
+    paths = np.zeros((b, t), np.int64)
+    for i in range(b):
+        L = int(length[i])
+        x = em[i, :L].astype(np.float64)
+        delta = start + x[0]
+        bp = np.zeros((L, d), np.int64)
+        for k in range(1, L):
+            scores = delta[:, None] + trans
+            bp[k] = scores.argmax(0)
+            delta = scores.max(0) + x[k]
+        tag = int((delta + end).argmax())
+        for k in range(L - 1, -1, -1):
+            paths[i, k] = tag
+            if k:
+                tag = int(bp[k][tag])
+    return paths
+
+
+def np_edit_distance(h, hl, r, rl):
+    out = np.zeros((len(h), 1), np.float32)
+    for i in range(len(h)):
+        a, bseq = list(h[i][:hl[i]]), list(r[i][:rl[i]])
+        n, m = len(a), len(bseq)
+        dp = np.zeros((n + 1, m + 1))
+        dp[:, 0] = np.arange(n + 1)
+        dp[0, :] = np.arange(m + 1)
+        for p in range(1, n + 1):
+            for q in range(1, m + 1):
+                dp[p, q] = min(dp[p - 1, q] + 1, dp[p, q - 1] + 1,
+                               dp[p - 1, q - 1] + (a[p - 1] != bseq[q - 1]))
+        out[i, 0] = dp[n, m] if m else n
+    return out
+
+
+def np_hsigmoid(xv, wv, bias, label, num_classes):
+    b = xv.shape[0]
+    cost = np.zeros((b, 1), np.float64)
+    for i in range(b):
+        c = int(label[i]) + num_classes
+        length = int(math.floor(math.log2(c)))
+        for j in range(length):
+            idx = (c >> (j + 1)) - 1
+            bit = (c >> j) & 1
+            z = xv[i] @ wv[idx] + bias[idx]
+            cost[i, 0] += math.log1p(math.exp(-abs(z))) + max(z, 0) - z * bit
+    return cost
+
+
+# -- tests ------------------------------------------------------------------
+
+class TestLinearChainCRF(OpTest):
+    def setup(self):
+        b, t, d = 3, 6, 4
+        em = RNG.randn(b, t, d).astype(np.float32)
+        w = (0.3 * RNG.randn(d + 2, d)).astype(np.float32)
+        length = np.array([6, 4, 1], np.int32)
+        label = RNG.randint(0, d, (b, t)).astype(np.int64)
+        nll = np_crf_nll(em, w, label, length).astype(np.float32)
+        self.op_type = "linear_chain_crf"
+        self.inputs = {"Emission": em, "Transition": w, "Label": label,
+                       "Length": length}
+        self.attrs = {}
+        self.outputs = {"LogLikelihood": nll}
+
+    def test(self):
+        self.check_output(atol=2e-4, rtol=2e-4,
+                          no_check=("Alpha", "EmissionExps",
+                                    "TransitionExps"))
+        self.check_grad(["Emission", "Transition"], "LogLikelihood",
+                        delta=1e-2, max_relative_error=0.02)
+
+
+class TestCRFDecoding(OpTest):
+    def setup(self):
+        b, t, d = 3, 7, 5
+        em = RNG.randn(b, t, d).astype(np.float32)
+        w = (0.5 * RNG.randn(d + 2, d)).astype(np.float32)
+        length = np.array([7, 3, 5], np.int32)
+        self.op_type = "crf_decoding"
+        self.inputs = {"Emission": em, "Transition": w, "Length": length}
+        self.attrs = {}
+        self.outputs = {"ViterbiPath": np_viterbi(em, w, length)}
+
+    def test(self):
+        self.check_output()
+
+
+def test_crf_decoding_label_mode():
+    """With Label, output is the 0/1 per-position correctness mask."""
+    b, t, d = 2, 5, 3
+    em = RNG.randn(b, t, d).astype(np.float32)
+    w = (0.5 * RNG.randn(d + 2, d)).astype(np.float32)
+    length = np.array([5, 4], np.int32)
+    path = np_viterbi(em, w, length)
+    label = path.copy()
+    label[0, 2] = (label[0, 2] + 1) % d  # one wrong position
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        blk = fluid.default_main_program().global_block
+        mk = lambda n, a: blk.create_var(
+            name=n, shape=a.shape,
+            dtype=str(a.dtype).replace("int32", "int32"), is_data=True)
+        vs = {n: mk(n, a) for n, a in
+              [("em", em), ("w", w), ("lbl", label), ("len", length)]}
+        outv = blk.create_var(name="out", shape=(b, t), dtype="int64")
+        blk.append_op("crf_decoding",
+                      inputs={"Emission": vs["em"], "Transition": vs["w"],
+                              "Label": vs["lbl"], "Length": vs["len"]},
+                      outputs={"ViterbiPath": outv})
+        exe = fluid.Executor(fluid.CPUPlace())
+        got = exe.run(fluid.default_main_program(),
+                      feed={"em": em, "w": w, "lbl": label, "len": length},
+                      fetch_list=["out"])[0]
+    expect = (path == label).astype(np.int64)
+    expect[0, length[0]:] = 0
+    expect[1, length[1]:] = 0
+    np.testing.assert_array_equal(np.asarray(got), expect)
+
+
+class TestEditDistance(OpTest):
+    def setup(self):
+        b, th, tr = 4, 8, 7
+        hyp = RNG.randint(1, 6, (b, th)).astype(np.int64)
+        ref = RNG.randint(1, 6, (b, tr)).astype(np.int64)
+        hl = np.array([8, 5, 3, 0], np.int32)
+        rl = np.array([7, 7, 2, 4], np.int32)
+        self.op_type = "edit_distance"
+        self.inputs = {"Hyps": hyp, "Refs": ref, "HypsLength": hl,
+                       "RefsLength": rl}
+        self.attrs = {"normalized": False}
+        self.outputs = {"Out": np_edit_distance(hyp, hl, ref, rl),
+                        "SequenceNum": np.array([b], np.int64)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestEditDistanceNormalized(TestEditDistance):
+    def setup(self):
+        super().setup()
+        self.attrs = {"normalized": True}
+        rl = self.inputs["RefsLength"]
+        self.outputs["Out"] = (
+            self.outputs["Out"] / np.maximum(rl, 1)[:, None]
+        ).astype(np.float32)
+
+
+class TestCTCAlign(OpTest):
+    def setup(self):
+        inp = np.array([[0, 1, 1, 0, 2, 2, 0, 3],
+                        [1, 1, 2, 0, 0, 2, 4, 4]], np.int64)
+        ilen = np.array([8, 6], np.int32)
+        # merge ADJACENT repeats then drop blanks (blank=0): row 1's
+        # [1,1,2,0,0,2] keeps both 2s — they are blank-separated (CTC rule)
+        expect = np.zeros((2, 8), np.int64)
+        expect[0, :3] = [1, 2, 3]
+        expect[1, :3] = [1, 2, 2]
+        self.op_type = "ctc_align"
+        self.inputs = {"Input": inp, "InputLength": ilen}
+        self.attrs = {"blank": 0, "merge_repeated": True}
+        self.outputs = {"Output": expect,
+                        "OutputLength": np.array([3, 3], np.int32)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestHSigmoid(OpTest):
+    def setup(self):
+        b, d, c = 5, 6, 7
+        xv = RNG.randn(b, d).astype(np.float32)
+        wv = (0.5 * RNG.randn(c - 1, d)).astype(np.float32)
+        bias = (0.1 * RNG.randn(c - 1)).astype(np.float32)
+        label = RNG.randint(0, c, (b, 1)).astype(np.int64)
+        self.op_type = "hierarchical_sigmoid"
+        self.inputs = {"X": xv, "W": wv, "Bias": bias, "Label": label}
+        self.attrs = {"num_classes": c}
+        self.outputs = {
+            "Out": np_hsigmoid(xv, wv, bias, label, c).astype(np.float32)}
+
+    def test(self):
+        self.check_output(atol=1e-4, rtol=1e-4, no_check=("PreOut",))
+        self.check_grad(["X", "W", "Bias"], "Out", delta=1e-2,
+                        max_relative_error=0.02)
+
+
+def test_nce_loss_trains_and_matches_shape():
+    """NCE is stochastic (sampled negatives) — check structure, a training
+    run, and the full-softmax sanity (cost finite + decreases)."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xv = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        lbl = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        cost = fluid.layers.nce(input=xv, label=lbl, num_total_classes=50,
+                                num_neg_samples=5, sampler="log_uniform")
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(0)
+        xb = rng.randn(16, 8).astype(np.float32)
+        yb = rng.randint(0, 50, (16, 1)).astype(np.int64)
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            vals = [float(np.asarray(exe.run(
+                fluid.default_main_program(), feed={"x": xb, "y": yb},
+                fetch_list=[loss])[0]).reshape(-1)[0]) for _ in range(30)]
+        assert np.isfinite(vals).all()
+        assert vals[-1] < vals[0]
+
+
+def test_chunk_eval_iob():
+    """IOB chunk F1 against hand-counted chunks (reference
+    chunk_eval_op.h): tags = type*2 + {B:0, I:1}, Other = 2*num_types."""
+    # types: 0, 1; O = 4. B0=0 I0=1 B1=2 I1=3
+    label = np.array([[0, 1, 4, 2, 3, 3],
+                      [2, 4, 0, 1, 1, 4]], np.int64)
+    infer = np.array([[0, 1, 4, 2, 3, 4],    # 2nd chunk ends early: wrong
+                      [2, 4, 0, 1, 1, 4]], np.int64)  # all correct
+    slen = np.array([6, 6], np.int32)
+    # label chunks: [0-1]x2 + [3-5] , [0]x1 + [2-4] = 4; infer: 4
+    # correct: seq0 [0,1]t0; seq1 [0]t1 + [2,4]t0 = 3
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        blk = fluid.default_main_program().global_block
+        iv = blk.create_var(name="i", shape=infer.shape, dtype="int64",
+                            is_data=True)
+        lv = blk.create_var(name="l", shape=label.shape, dtype="int64",
+                            is_data=True)
+        sv = blk.create_var(name="s", shape=slen.shape, dtype="int32",
+                            is_data=True)
+        outs = {k: blk.create_var(name=k.lower(), shape=(1,),
+                                  dtype="float32" if k in
+                                  ("Precision", "Recall", "F1-Score")
+                                  else "int64")
+                for k in ("Precision", "Recall", "F1-Score",
+                          "NumInferChunks", "NumLabelChunks",
+                          "NumCorrectChunks")}
+        blk.append_op("chunk_eval",
+                      inputs={"Inference": iv, "Label": lv, "SeqLength": sv},
+                      outputs={k: v for k, v in outs.items()},
+                      attrs={"num_chunk_types": 2, "chunk_scheme": "IOB",
+                             "excluded_chunk_types": []})
+        exe = fluid.Executor(fluid.CPUPlace())
+        res = exe.run(fluid.default_main_program(),
+                      feed={"i": infer, "l": label, "s": slen},
+                      fetch_list=[outs["NumInferChunks"],
+                                  outs["NumLabelChunks"],
+                                  outs["NumCorrectChunks"],
+                                  outs["Precision"], outs["Recall"]])
+    n_i, n_l, n_c, p, r = [np.asarray(v).reshape(-1)[0] for v in res]
+    assert (n_i, n_l, n_c) == (4, 4, 3), (n_i, n_l, n_c)
+    np.testing.assert_allclose(p, 0.75, rtol=1e-6)
+    np.testing.assert_allclose(r, 0.75, rtol=1e-6)
+
+
+def test_crf_layer_end_to_end_training():
+    """linear_chain_crf + crf_decoding as layers: loss decreases and decode
+    recovers a learnable pattern (the label IS argmax-able from emission)."""
+    b, t, d = 8, 6, 4
+    rng = np.random.RandomState(3)
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        feat = fluid.layers.data(name="feat", shape=[t, d], dtype="float32",
+                                 lod_level=0)
+        lbl = fluid.layers.data(name="lbl", shape=[t], dtype="int64")
+        lens = fluid.layers.data(name="lens", shape=[], dtype="int32")
+        em = fluid.layers.fc(input=feat, size=d, num_flatten_dims=2)
+        crf_cost = fluid.layers.linear_chain_crf(
+            input=em, label=lbl, length=lens,
+            param_attr=fluid.ParamAttr(name="crfw"))
+        loss = fluid.layers.mean(crf_cost)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        decode = fluid.layers.crf_decoding(
+            input=em, param_attr=fluid.ParamAttr(name="crfw"), length=lens)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        y = rng.randint(0, d, (b, t)).astype(np.int64)
+        xb = np.eye(d, dtype=np.float32)[y] + 0.1 * rng.randn(
+            b, t, d).astype(np.float32)
+        ln = np.full((b,), t, np.int32)
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            losses = []
+            for _ in range(60):
+                out = exe.run(fluid.default_main_program(),
+                              feed={"feat": xb, "lbl": y, "lens": ln},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+            path = np.asarray(exe.run(
+                fluid.default_main_program(),
+                feed={"feat": xb, "lbl": y, "lens": ln},
+                fetch_list=[decode])[0])
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+    assert (path == y).mean() > 0.9
