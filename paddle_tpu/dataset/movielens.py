@@ -62,13 +62,16 @@ def _factors():
 
 
 def user_info():
+    """Per-user metadata (reference movielens.py user_info contract)."""
     _, _, meta = _factors()
-    return meta
+    return {"gender": meta["gender"], "age": meta["age"],
+            "job": meta["job"]}
 
 
 def movie_info():
+    """Per-movie metadata (reference movielens.py movie_info contract)."""
     _, _, meta = _factors()
-    return meta
+    return {"categories": meta["cats"], "title_ids": meta["titles"]}
 
 
 def _reader(n, seed):

@@ -383,13 +383,19 @@ def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
 
 def fused_multihead_attention(q, k, v, bias_qk=None, causal=False,
                               scale=0.0, attn_dropout=0.0, is_test=False,
-                              name=None):
+                              sequence_parallel=False, name=None):
     """Fused multi-head attention (the reference `operators/fused/` role,
     here a Pallas flash kernel on TPU — ops/fused_attention.py).
 
     q/k/v: [B, num_heads, S, head_dim]; bias_qk: optional additive key bias
     [B, S] or [B, 1, 1, S] (padding-mask encoding). Returns the same shape
-    as q. scale=0.0 means 1/sqrt(head_dim)."""
+    as q. scale=0.0 means 1/sqrt(head_dim).
+
+    sequence_parallel=True: when the program runs under a mesh with an
+    'sp' axis (CompiledProgram places=mesh), attention runs as ring
+    attention over that axis — sequence/context parallelism for sequences
+    too long for one chip. bias_qk/attn_dropout are unsupported on that
+    path; without an sp axis it degrades to the plain fused path."""
     helper = LayerHelper("fused_multihead_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     inputs = {"Q": q, "K": k, "V": v}
@@ -399,7 +405,8 @@ def fused_multihead_attention(q, k, v, bias_qk=None, causal=False,
                      outputs={"Out": out},
                      attrs={"causal": causal, "scale": scale,
                             "attn_dropout": attn_dropout,
-                            "is_test": is_test})
+                            "is_test": is_test,
+                            "sequence_parallel": sequence_parallel})
     return out
 
 

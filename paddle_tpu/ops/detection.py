@@ -541,7 +541,17 @@ def _distribute_fpn_proposals(ctx, ins, attrs):
     # must reach NO level: they'd otherwise compute w=h=1 and flood min_level
     valid = rois[:, 2] >= 0
     if rois_num is not None:
-        valid = valid & (jnp.arange(r) < rois_num.reshape(-1).sum())
+        # per-image layout: counts[i] valid rows lead each equal-size block
+        # (exactly what a flattened [N, post, 4] from generate_proposals
+        # is); a single count degenerates to the whole-tensor prefix
+        counts = rois_num.reshape(-1)
+        n_img = counts.shape[0]
+        if r % n_img == 0:
+            blk = r // n_img
+            pos = jnp.arange(r)
+            valid = valid & ((pos % blk) < counts[pos // blk])
+        else:
+            valid = valid & (jnp.arange(r) < counts.sum())
     off = 1.0 if attrs.get("pixel_offset", True) else 0.0
     ws = rois[:, 2] - rois[:, 0] + off
     hs = rois[:, 3] - rois[:, 1] + off

@@ -452,3 +452,107 @@ def _cross_entropy2(ctx, ins, attrs):
     y = jnp.where(li == ignore, 0.0, -jnp.log(jnp.maximum(match, 1e-20)))
     return {"Y": [y], "XShape": [jnp.asarray(xv.shape, jnp.int64)],
             "MatchX": [match]}
+
+
+@register_op("spectral_norm",
+             inputs=[IOSpec("Weight"), IOSpec("U", no_grad=True),
+                     IOSpec("V", no_grad=True)],
+             outputs=["Out"],
+             attrs={"dim": 0, "power_iters": 1, "eps": 1e-12})
+def _spectral_norm(ctx, ins, attrs):
+    """Weight / sigma_max (reference spectral_norm_op.h): sigma estimated by
+    power iteration from the U/V buffers. Deviation from the reference: the
+    reference mutates its U/V inputs in place so iterations accumulate
+    across steps; here the op is pure — U/V are a warm start and
+    ``power_iters`` iterations run per call (raise power_iters for the same
+    effect). Iterations run under stop_gradient like the reference."""
+    w = x(ins, "Weight")
+    u = x(ins, "U").reshape(-1)
+    v = x(ins, "V").reshape(-1)
+    dim, iters, eps = (int(attrs.get("dim", 0)),
+                       int(attrs.get("power_iters", 1)),
+                       float(attrs.get("eps", 1e-12)))
+    perm = [dim] + [i for i in range(w.ndim) if i != dim]
+    mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)   # [h, rest]
+
+    def norm(a):
+        return a / (jnp.linalg.norm(a) + eps)
+
+    u, v = jax.lax.stop_gradient(u), jax.lax.stop_gradient(v)
+    for _ in range(max(iters, 0)):
+        v = norm(jax.lax.stop_gradient(mat).T @ u)
+        u = norm(jax.lax.stop_gradient(mat) @ v)
+    sigma = u @ (mat @ v)
+    return out(w / sigma)
+
+
+@register_op("tree_conv",
+             inputs=[IOSpec("NodesVector"), IOSpec("EdgeSet", no_grad=True),
+                     IOSpec("Filter")],
+             outputs=["Out"], attrs={"max_depth": 2})
+def _tree_conv(ctx, ins, attrs):
+    """Tree-based convolution (reference tree_conv_op.cc, Mou et al.
+    TBCNN). The reference builds per-root DFS patches on CPU
+    (math/tree2col.cc construct_patch); here the patch sum is re-derived as
+    ``max_depth`` powers of the child-adjacency matrix, so the whole op is
+    three matmul chains per depth — MXU-friendly and O(d * N^2 * F).
+
+    NodesVector [B, N, F] (node id v -> row v-1), EdgeSet [B, E, 2]
+    (parent, child) node-id pairs, 0 = padding, edge order defines sibling
+    order. Filter [F, 3, out, k] with the reference's (l, r, t) slot
+    layout. Out [B, N, out, k]; roots whose patch is empty produce zeros
+    (the reference drops them from its packed output; fixed shapes keep
+    them as zero rows)."""
+    feats = x(ins, "NodesVector")
+    edges = x(ins, "EdgeSet").astype(jnp.int32)
+    filt = x(ins, "Filter")
+    b, n, f = feats.shape
+    e = edges.shape[1]
+    m = int(attrs.get("max_depth", 2))
+    f_l, f_r, f_t = filt[:, 0], filt[:, 1], filt[:, 2]     # [F, out, k]
+    out_sz, k = filt.shape[2], filt.shape[3]
+
+    def one(feat, edge):
+        uu, vv = edge[:, 0], edge[:, 1]                    # node ids, 1-based
+        live = (uu > 0) & (vv > 0)
+        # child adjacency over 0-based rows; dead edges -> dropped
+        a = jnp.zeros((n, n), feat.dtype).at[
+            jnp.where(live, uu - 1, n),
+            jnp.where(live, vv - 1, n)].set(1.0, mode="drop")
+        # sibling index (1-based, edge order) and sibling count per child
+        same_parent = (uu[None, :] == uu[:, None]) & live[None, :] & \
+            live[:, None]
+        earlier = jnp.tril(jnp.ones((e, e), bool), k=-1)  # [i,j]=1 iff j<i
+        idx_edge = jnp.sum(same_parent & earlier, axis=1) + 1     # [E]
+        pclen_edge = jnp.sum(same_parent, axis=1)
+        sib_idx = jnp.ones((n,), feat.dtype).at[
+            jnp.where(live, vv - 1, n)].set(
+            idx_edge.astype(feat.dtype), mode="drop")
+        pclen = jnp.ones((n,), feat.dtype).at[
+            jnp.where(live, vv - 1, n)].set(
+            pclen_edge.astype(feat.dtype), mode="drop")
+        tmp = jnp.where(pclen == 1, 0.5, (sib_idx - 1)
+                        / jnp.maximum(pclen - 1, 1))
+        acc = jnp.zeros((n, out_sz * k), feat.dtype)
+        w_l = f_l.reshape(f, -1)
+        w_r = f_r.reshape(f, -1)
+        w_t = f_t.reshape(f, -1)
+        reach = jnp.eye(n, dtype=feat.dtype)               # A^0
+        for d in range(m):
+            et = (m - d) / m
+            xt = reach @ feat                              # [N, F]
+            xl = reach @ (tmp[:, None] * feat)
+            # root slot (d=0): index=1, pclen=1 by construction of the
+            # reference patch -> tmp must read 0.5 there, which the xl
+            # term with per-node tmp violates; d=0 uses the root's OWN
+            # sibling data in the reference? No: construct_patch pushes
+            # the root as TreeNode(root, 1, 1, 0) -> tmp = 0.5. But at
+            # d=0 the eta_l/eta_r factors are (1-et)=0, so the term
+            # vanishes and per-node tmp is harmless.
+            el_x = (1 - et) * xl
+            er_x = (1 - et) * xt - (1 - et) ** 2 * xl
+            acc = acc + et * (xt @ w_t) + el_x @ w_l + er_x @ w_r
+            reach = reach @ a
+        return acc.reshape(n, out_sz, k)
+
+    return out(jax.vmap(one)(feats, edges))

@@ -373,6 +373,8 @@ def _prelu(ctx, ins, attrs):
     mode = attrs.get("mode", "all")
     if mode == "channel":
         alpha = alpha.reshape((1, -1) + (1,) * (xv.ndim - 2))
+    elif mode == "element":
+        alpha = alpha.reshape((1,) + xv.shape[1:])
     elif mode == "all":
         alpha = alpha.reshape(())
     return out(jnp.where(xv > 0, xv, alpha * xv))

@@ -190,11 +190,15 @@ def _nce_sample(key, sampler, shape, vocab):
                     "sampler": 0, "seed": 0, "is_sparse": False,
                     "remote_prefetch": False, "custom_neg_classes": []})
 def _nce(ctx, ins, attrs):
-    """Sampled logistic NCE loss (reference nce_op.h:96 forward): for true
-    classes o = sigmoid(s - log(k*q)), cost -= log(o); for k sampled
-    negatives cost -= log(1 - o). Sampling uses the op's folded PRNG key, so
-    the grad replay (generic vjp re-trace with the same uid) draws the SAME
-    negatives — the property the reference gets by seeding per-op."""
+    """NCE loss, exact reference math (nce_op.h:237-245): o = sigmoid(s),
+    b = k * q(class); cost_true = -log(o / (o + b)), cost_neg =
+    -log(b / (o + b)) — computed in stable softplus form:
+    cost_true = softplus(log(b) + softplus(-s)),
+    cost_neg  = softplus(-softplus(-s) - log(b)).
+    SampleLogits carries sigmoid(s) like the reference. Sampling uses the
+    op's folded PRNG key, so the grad replay (generic vjp re-trace with the
+    same uid) draws the SAME negatives — the property the reference gets by
+    seeding per-op."""
     inp = x(ins, "Input")
     label = x(ins, "Label").astype(jnp.int32)
     if label.ndim == 1:
@@ -224,16 +228,17 @@ def _nce(ctx, ins, attrs):
         q = _log_uniform_probs(all_ids, vocab)
     else:
         q = jnp.full(all_ids.shape, 1.0 / vocab)
-    adj = logits - jnp.log(k * q)
-    # stable log-sigmoid forms
-    log_sig = -jax.nn.softplus(-adj)                # log(sigmoid)
-    log_one_minus = -jax.nn.softplus(adj)           # log(1 - sigmoid)
-    cost = -(jnp.sum(log_sig[:, :num_true], 1)
-             + jnp.sum(log_one_minus[:, num_true:], 1))
+    log_b = jnp.log(k * q)
+    sp_neg_s = jax.nn.softplus(-logits)             # -log(sigmoid(s))
+    cost_true = jax.nn.softplus(log_b + sp_neg_s)   # -log(o / (o + b))
+    cost_neg = jax.nn.softplus(-sp_neg_s - log_b)   # -log(b / (o + b))
+    cost = (jnp.sum(cost_true[:, :num_true], 1)
+            + jnp.sum(cost_neg[:, num_true:], 1))
     sw = x(ins, "SampleWeight")
     if sw is not None:
         cost = cost * sw.reshape(-1)
-    return {"Cost": [cost.reshape(b, 1)], "SampleLogits": [logits],
+    return {"Cost": [cost.reshape(b, 1)],
+            "SampleLogits": [jax.nn.sigmoid(logits)],
             "SampleLabels": [all_ids.astype(jnp.int64)]}
 
 

@@ -29,7 +29,7 @@ __all__ = [
     "RMSPropOptimizer", "FtrlOptimizer", "LambOptimizer",
     "LarsMomentumOptimizer", "ExponentialMovingAverage", "ModelAverage",
     "LookaheadOptimizer", "RecomputeOptimizer", "PipelineOptimizer",
-    "GradientMergeOptimizer",
+    "GradientMergeOptimizer", "DGCMomentumOptimizer",
 ]
 
 
@@ -177,21 +177,19 @@ class Optimizer:
             raise NotImplementedError(
                 f"{type(self).__name__} has no eager (dygraph) update path "
                 f"yet — supported: SGD, Momentum, Adam/AdamW/Lamb")
-        from .clip import _clip_attr
-
-        if _clip_attr.get("__global__") is not None:
-            raise NotImplementedError(
-                "set_gradient_clip is not applied in dygraph mode — clip "
-                "gradients manually before minimize")
         params = [p for p in parameter_list if p._grad is not None]
         if not params:
             raise RuntimeError(
                 "no gradients found — call loss.backward() before minimize")
+        clipped = self._eager_clip_grads(params)
         lr = self._current_lr()
         ctx = LowerCtx()
         updated = []
         for p in params:
-            grad = self._eager_regularized_grad(p)
+            # static-path order (reference _create_optimization_pass):
+            # clip first, then fold regularization into the clipped grad
+            base_grad = clipped[id(p)] if clipped is not None else p._grad
+            grad = self._eager_regularized_grad(p, base_grad)
             slots = self._eager_slots(p)
             ins = {"Param": [p.value],
                    "Grad": [grad],
@@ -205,14 +203,46 @@ class Optimizer:
             updated.append(p)
         return updated, [(p, p._grad) for p in params]
 
-    def _eager_regularized_grad(self, p):
+    def _eager_clip_grads(self, params):
+        """Apply set_gradient_clip eagerly (the static path's
+        append_gradient_clip_ops, over jnp values): returns {id(p): grad}
+        or None when no clip is installed."""
+        import jax.numpy as jnp
+
+        from .clip import (GradientClipByGlobalNorm, GradientClipByNorm,
+                           GradientClipByValue, _clip_attr)
+
+        clip = _clip_attr.get("__global__")
+        if clip is None:
+            return None
+        grads = {id(p): p._grad for p in params}
+        if isinstance(clip, GradientClipByValue):
+            return {k: jnp.clip(g, clip.min, clip.max)
+                    for k, g in grads.items()}
+        if isinstance(clip, GradientClipByNorm):
+            out = {}
+            for k, g in grads.items():
+                norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+                s = jnp.minimum(clip.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+                out[k] = g * s
+            return out
+        if isinstance(clip, GradientClipByGlobalNorm):
+            total = sum(jnp.sum(jnp.square(g)) for g in grads.values())
+            gnorm = jnp.sqrt(total)
+            scale = clip.clip_norm / jnp.maximum(gnorm, clip.clip_norm)
+            return {k: g * scale for k, g in grads.items()}
+        raise NotImplementedError(
+            f"dygraph clip for {type(clip).__name__}")
+
+    def _eager_regularized_grad(self, p, g=None):
         """L1/L2 weight decay folded into the grad, matching the static
         append_regularization_ops semantics."""
         import jax.numpy as jnp
 
         from .regularizer import L1DecayRegularizer, L2DecayRegularizer
 
-        g = p._grad
+        g = p._grad if g is None else g
         reg = self.regularization
         if reg is None:
             return g
@@ -225,8 +255,13 @@ class Optimizer:
 
     def _current_lr(self) -> float:
         lr = self._learning_rate
+        from .dygraph.learning_rate_scheduler import LearningRateDecay
+
+        if isinstance(lr, LearningRateDecay):
+            return lr()  # evaluates current rate, advances step_num
         if isinstance(lr, Variable):
-            raise TypeError("dygraph mode needs a float learning rate")
+            raise TypeError("dygraph mode needs a float learning rate or a "
+                            "dygraph LearningRateDecay scheduler")
         return float(lr)
 
     def _eager_state(self, p) -> dict:
@@ -983,6 +1018,39 @@ class RecomputeOptimizer:
                                          parameter_list, no_grad_set)
             optimize_ops = self._optimizer.apply_gradients(params_grads)
         return optimize_ops, params_grads
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """Deep Gradient Compression momentum — intentionally unsupported on
+    TPU; this class IS the decision surface (the async-PS/GEO pattern).
+
+    The reference (operators/optimizers/dgc_momentum_op / framework/details/
+    sparse_all_reduce_op_handle.h:30) sparsifies each gradient to its top-k
+    entries before all-reduce to save NETWORK bandwidth on commodity
+    interconnects, trading exactness plus host-side encode/decode for fewer
+    bytes on the wire. On a TPU pod the economics invert: dense all-reduce
+    rides ICI at hundreds of GB/s with zero host involvement, while top-k
+    selection + irregular gather/scatter are the expensive part — DGC is a
+    pessimization, not an optimization, on this hardware. Momentum
+    correction/clipping exist solely to patch DGC's convergence, so there
+    is nothing worth keeping.
+
+    Migration: plain ``Momentum`` (dense ICI all-reduce is cheap), or
+    ``fleet.DistributedStrategy(use_local_sgd=True)`` when communication
+    frequency — not volume — is the constraint (multi-host over DCN).
+    """
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 local_grad_clip_norm=None, num_trainers=None,
+                 regularization=None, name=None):
+        raise NotImplementedError(
+            "DGCMomentumOptimizer is intentionally unsupported on TPU: "
+            "top-k gradient sparsification saves network bytes at the cost "
+            "of top-k + irregular scatter compute, which on ICI-connected "
+            "chips is slower than the dense all-reduce it replaces. Use "
+            "Momentum (dense collectives), or fleet.DistributedStrategy("
+            "use_local_sgd=True) to cut communication FREQUENCY instead.")
 
 
 # canonical short aliases (v2-style names)

@@ -1,0 +1,225 @@
+"""Dygraph layer zoo round-5 additions (reference dygraph/nn.py:1509-2762:
+GRUUnit, NCE, PRelu, BilinearTensorProduct, Conv2DTranspose, GroupNorm,
+SpectralNorm, TreeConv, RowConv, SequenceConv) + dygraph LR schedulers
+(dygraph/learning_rate_scheduler.py) and eager gradient clipping."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+
+RNG = np.random.RandomState(42)
+
+
+def _np(v):
+    return np.asarray(v.value if hasattr(v, "value") else v)
+
+
+def test_gru_unit_steps_and_grads():
+    with dygraph.guard():
+        cell = dygraph.GRUUnit(size=3 * 8)
+        x = dygraph.to_variable(RNG.randn(4, 24).astype(np.float32))
+        h = dygraph.to_variable(np.zeros((4, 8), np.float32))
+        gate, reset, hidden = cell(x, h)
+        assert _np(hidden).shape == (4, 8)
+        loss = dygraph.ops.reduce_mean(dygraph.ops.square(hidden))
+        loss.backward()
+        assert cell.weight._grad is not None
+
+
+def test_nce_layer_trains():
+    with dygraph.guard():
+        dygraph.seed_parameters(0)
+        head = dygraph.NCE(num_total_classes=30, dim=16, num_neg_samples=5)
+        opt = fluid.optimizer.SGD(learning_rate=0.3)
+        rng = np.random.RandomState(0)
+        W = rng.randn(16, 30)
+        vals = []
+        for _ in range(120):
+            xb = rng.randn(32, 16).astype(np.float32)
+            yb = (xb @ W).argmax(1)[:, None].astype(np.int64)
+            x = dygraph.to_variable(xb)
+            y = dygraph.to_variable(yb)
+            cost = dygraph.ops.reduce_mean(head(x, y))
+            cost.backward()
+            opt.minimize(cost, parameter_list=head.parameters())
+            head.clear_gradients()
+            vals.append(float(_np(cost).reshape(-1)[0]))
+        assert vals[-1] < 0.5 * vals[0], (vals[0], vals[-1])
+
+
+def test_prelu_modes():
+    with dygraph.guard():
+        x = dygraph.to_variable(RNG.randn(2, 3, 4, 4).astype(np.float32))
+        for mode, kw in [("all", {}), ("channel", {"channel": 3}),
+                         ("element", {"input_shape": [3, 4, 4]})]:
+            layer = dygraph.PRelu(mode=mode, **kw)
+            y = _np(layer(x))
+            xin = _np(x)
+            assert y.shape == xin.shape
+            np.testing.assert_allclose(y[xin > 0], xin[xin > 0], rtol=1e-6)
+            np.testing.assert_allclose(y[xin < 0], 0.25 * xin[xin < 0],
+                                       rtol=1e-5)
+
+
+def test_bilinear_tensor_product():
+    with dygraph.guard():
+        layer = dygraph.BilinearTensorProduct(input1_dim=4, input2_dim=5,
+                                              output_dim=3)
+        x = dygraph.to_variable(RNG.randn(6, 4).astype(np.float32))
+        y = dygraph.to_variable(RNG.randn(6, 5).astype(np.float32))
+        out = layer(x, y)
+        assert _np(out).shape == (6, 3)
+        W = _np(layer.weight)
+        expect = np.einsum("bi,kij,bj->bk", _np(x), W, _np(y)) \
+            + _np(layer.bias)
+        np.testing.assert_allclose(_np(out), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_transpose_shape_and_grad():
+    with dygraph.guard():
+        layer = dygraph.Conv2DTranspose(num_channels=3, num_filters=5,
+                                        filter_size=3, stride=2, padding=1)
+        x = dygraph.to_variable(RNG.randn(2, 3, 8, 8).astype(np.float32))
+        y = layer(x)
+        assert _np(y).shape[:2] == (2, 5)
+        loss = dygraph.ops.reduce_mean(dygraph.ops.square(y))
+        loss.backward()
+        assert layer.weight._grad is not None
+
+
+def test_group_norm_normalizes():
+    with dygraph.guard():
+        layer = dygraph.GroupNorm(channels=8, groups=2)
+        x = dygraph.to_variable(RNG.randn(4, 8, 5, 5).astype(np.float32))
+        y = _np(layer(x))
+        # per-(sample, group) statistics ~ (0, 1)
+        g = y.reshape(4, 2, 4 * 5 * 5)
+        np.testing.assert_allclose(g.mean(-1), 0.0, atol=1e-4)
+        np.testing.assert_allclose(g.std(-1), 1.0, atol=1e-3)
+
+
+def test_spectral_norm_shrinks_sigma():
+    with dygraph.guard():
+        w = RNG.randn(6, 10).astype(np.float32)
+        layer = dygraph.SpectralNorm(weight_shape=[6, 10], power_iters=30)
+        wv = dygraph.to_variable(w)
+        y = _np(layer(wv))
+        # sigma_max of the normalized weight must be ~1
+        s = np.linalg.svd(y, compute_uv=False)[0]
+        np.testing.assert_allclose(s, 1.0, rtol=1e-2)
+
+
+def test_tree_conv_matches_reference_patch_semantics():
+    """Single tree: 1 -> (2, 3); max_depth=2. Hand-computed patch sums via
+    the reference eta formulas (tree2col.h:35-52)."""
+    with dygraph.guard():
+        f, out_sz, k = 2, 3, 1
+        layer = dygraph.TreeConv(feature_size=f, output_size=out_sz,
+                                 num_filters=k, max_depth=2, act=None)
+        nodes = RNG.randn(1, 3, f).astype(np.float32)
+        edges = np.array([[[1, 2], [1, 3], [0, 0]]], np.int64)
+        y = _np(layer(dygraph.to_variable(nodes),
+                      dygraph.to_variable(edges)))
+        assert y.shape == (1, 3, out_sz, k)
+        W = _np(layer.weight)  # [F, 3(l,r,t), out, k]
+        M = 2.0
+
+        def eta(depth, idx, pclen):
+            et = (M - depth) / M
+            tmp = 0.5 if pclen == 1 else (idx - 1) / (pclen - 1)
+            el = (1 - et) * tmp
+            er = (1 - et) * (1 - el)
+            return el, er, et
+
+        # patch(root=1): (1,idx1,pclen1,d0), (2,idx1,pclen2,d1),
+        #                (3,idx2,pclen2,d1)
+        expect = np.zeros((out_sz, k))
+        for nid, idx, pclen, d in [(1, 1, 1, 0), (2, 1, 2, 1), (3, 2, 2, 1)]:
+            el, er, et = eta(d, idx, pclen)
+            xv = nodes[0, nid - 1]
+            expect += np.einsum("f,fok->ok",
+                                xv, el * W[:, 0] + er * W[:, 1]
+                                + et * W[:, 2])
+        np.testing.assert_allclose(y[0, 0], expect, rtol=1e-4, atol=1e-5)
+        # leaves' patches are just themselves (no children): only eta_t
+        for nid in (2, 3):
+            el, er, et = eta(0, 1, 1)
+            exp_leaf = np.einsum("f,fok->ok", nodes[0, nid - 1],
+                                 el * W[:, 0] + er * W[:, 1] + et * W[:, 2])
+            np.testing.assert_allclose(y[0, nid - 1], exp_leaf, rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_row_conv_and_sequence_conv():
+    with dygraph.guard():
+        x = dygraph.to_variable(RNG.randn(2, 6, 4).astype(np.float32))
+        rc = dygraph.RowConv(future_context_size=2, dim=4)
+        assert _np(rc(x)).shape == (2, 6, 4)
+        sc = dygraph.SequenceConv(dim=4, num_filters=7, filter_size=3)
+        lens = dygraph.to_variable(np.array([6, 4], np.int32))
+        assert _np(sc(x, lens)).shape == (2, 6, 7)
+
+
+def test_dygraph_lr_schedulers():
+    sched = dygraph.ExponentialDecay(learning_rate=1.0, decay_steps=10,
+                                     decay_rate=0.5, staircase=True)
+    rates = [sched() for _ in range(25)]
+    assert rates[0] == 1.0 and rates[9] == 1.0
+    assert rates[10] == 0.5 and rates[20] == 0.25
+
+    noam = dygraph.NoamDecay(d_model=64, warmup_steps=10)
+    rs = [noam() for _ in range(30)]
+    assert np.argmax(rs) == 9  # peak at warmup boundary
+
+    pw = dygraph.PiecewiseDecay([5, 10], [1.0, 0.5, 0.1], begin=0)
+    rs = [pw() for _ in range(12)]
+    assert rs[0] == 1.0 and rs[5] == 0.5 and rs[11] == 0.1
+
+    cos = dygraph.CosineDecay(1.0, step_each_epoch=2, epochs=4)
+    assert abs(cos() - 1.0) < 1e-6
+
+    poly = dygraph.PolynomialDecay(1.0, decay_steps=10,
+                                   end_learning_rate=0.1)
+    first = poly()
+    for _ in range(20):
+        last = poly()
+    assert first == 1.0 and abs(last - 0.1) < 1e-6
+
+
+def test_scheduler_drives_optimizer():
+    with dygraph.guard():
+        fc = dygraph.FC(4, 1)
+        sched = dygraph.PiecewiseDecay([2], [0.5, 0.0], begin=0)
+        opt = fluid.optimizer.SGD(learning_rate=sched)
+        x = dygraph.to_variable(np.ones((2, 4), np.float32))
+        w_before = _np(fc.weight).copy()
+        for i in range(4):
+            loss = dygraph.ops.reduce_mean(fc(x))
+            loss.backward()
+            opt.minimize(loss, parameter_list=fc.parameters())
+            fc.clear_gradients()
+            if i == 1:
+                w_mid = _np(fc.weight).copy()
+        # steps 0-1 move (lr 0.5), steps 2-3 frozen (lr 0.0)
+        assert np.abs(w_mid - w_before).max() > 0
+        np.testing.assert_array_equal(_np(fc.weight), w_mid)
+
+
+def test_eager_gradient_clip_global_norm():
+    try:
+        with dygraph.guard():
+            fluid.clip.set_gradient_clip(
+                fluid.clip.GradientClipByGlobalNorm(clip_norm=1e-3))
+            fc = dygraph.FC(4, 1)
+            opt = fluid.optimizer.SGD(learning_rate=1.0)
+            x = dygraph.to_variable(100 * np.ones((2, 4), np.float32))
+            w0 = _np(fc.weight).copy()
+            loss = dygraph.ops.reduce_mean(fc(x))
+            loss.backward()
+            opt.minimize(loss, parameter_list=fc.parameters())
+            # update magnitude bounded by lr * clip_norm
+            delta = np.abs(_np(fc.weight) - w0).max()
+            assert delta <= 1e-3 + 1e-7, delta
+    finally:
+        fluid.clip.set_gradient_clip(None)

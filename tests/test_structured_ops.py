@@ -227,6 +227,51 @@ class TestHSigmoid(OpTest):
                         max_relative_error=0.02)
 
 
+def test_nce_cost_matches_reference_formula():
+    """Exact oracle (reference nce_op.h:237-245): fetch the op's own
+    SampleLabels, recompute cost as -log(o/(o+b)) / -log(b/(o+b)) with
+    o = sigmoid(s), b = k*q(class) in numpy, compare."""
+    b, d, c, k = 4, 6, 12, 3
+    xv = RNG.randn(b, d).astype(np.float32)
+    wv = (0.5 * RNG.randn(c, d)).astype(np.float32)
+    bias = (0.1 * RNG.randn(c)).astype(np.float32)
+    label = RNG.randint(0, c, (b, 1)).astype(np.int64)
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        blk = fluid.default_main_program().global_block
+        mk = lambda n, a, dt: blk.create_var(name=n, shape=a.shape,
+                                             dtype=dt, is_data=True)
+        vs = {"x": mk("x", xv, "float32"), "w": mk("w", wv, "float32"),
+              "bias": mk("bias", bias, "float32"),
+              "lbl": mk("lbl", label, "int64")}
+        cost_v = blk.create_var(name="cost", shape=(b, 1), dtype="float32")
+        sl_v = blk.create_var(name="slog", shape=(b, 1 + k),
+                              dtype="float32")
+        ids_v = blk.create_var(name="sids", shape=(b, 1 + k), dtype="int64")
+        blk.append_op("nce",
+                      inputs={"Input": vs["x"], "Label": vs["lbl"],
+                              "Weight": vs["w"], "Bias": vs["bias"]},
+                      outputs={"Cost": cost_v, "SampleLogits": sl_v,
+                               "SampleLabels": ids_v},
+                      attrs={"num_total_classes": c, "num_neg_samples": k,
+                             "sampler": 0, "seed": 7})
+        exe = fluid.Executor(fluid.CPUPlace())
+        cost, slog, sids = [np.asarray(v) for v in exe.run(
+            fluid.default_main_program(),
+            feed={"x": xv, "w": wv, "bias": bias, "lbl": label},
+            fetch_list=["cost", "slog", "sids"])]
+    s = np.einsum("bd,bsd->bs", xv, wv[sids]) + bias[sids]
+    o = 1.0 / (1.0 + np.exp(-s))
+    np.testing.assert_allclose(slog, o, rtol=1e-5, atol=1e-6)
+    bq = k * (1.0 / c)  # uniform sampler
+    expect = np.zeros(b)
+    for i in range(b):
+        expect[i] = -np.log(o[i, 0] / (o[i, 0] + bq))
+        for j in range(1, 1 + k):
+            expect[i] += -np.log(bq / (o[i, j] + bq))
+    np.testing.assert_allclose(cost.reshape(-1), expect, rtol=1e-4,
+                               atol=1e-5)
+
+
 def test_nce_loss_trains_and_matches_shape():
     """NCE is stochastic (sampled negatives) — check structure, a training
     run, and the full-softmax sanity (cost finite + decreases)."""
