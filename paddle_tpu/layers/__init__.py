@@ -2,11 +2,13 @@
 from . import math_op_patch  # noqa: F401
 from .io import data  # noqa: F401
 from .nn import *  # noqa: F401,F403
+from .nn2 import *  # noqa: F401,F403
 from .tensor import (assign, create_global_var, create_tensor,  # noqa: F401
                      fill_constant, fill_constant_batch_size_like,
                      gaussian_random, linspace, ones, ones_like,
                      uniform_random, zeros, zeros_like)
 from . import nn  # noqa: F401
+from . import nn2  # noqa: F401
 from .control_flow import (While, Switch, IfElse, StaticRNN,  # noqa: F401
                            array_length, array_read, array_write, cond,
                            create_array, tensor_array_to_tensor)
@@ -14,6 +16,8 @@ from . import control_flow  # noqa: F401
 from . import tensor  # noqa: F401
 from .sequence import (sequence_pool, sequence_softmax,  # noqa: F401
                        sequence_reverse, sequence_expand, sequence_concat,
+                       sequence_reshape, sequence_expand_as,
+                       sequence_scatter, lod_reset, lod_append,
                        sequence_pad, sequence_unpad, sequence_slice,
                        sequence_erase, sequence_enumerate, sequence_conv,
                        sequence_first_step, sequence_last_step, sequence_mask)

@@ -1,0 +1,898 @@
+"""Extended fluid.layers surface — the long tail of reference
+python/paddle/fluid/layers/nn.py functions whose ops already exist in the
+registry but had no layer-building wrapper, plus reference pure-python
+composites (dice_loss, mse_loss, npair_loss, image_resize_short,
+fsp_matrix). Signatures mirror the reference; each wrapper is the standard
+LayerHelper -> append_op -> Variable pattern."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..initializer import Constant, Normal, Xavier
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+from . import nn as _nn
+
+__all__ = [
+    "conv3d", "pool3d", "conv3d_transpose", "adaptive_pool2d", "lrn",
+    "pad_constant_like", "label_smooth", "gather_nd", "scatter_nd_add",
+    "scatter_nd", "crop", "crop_tensor", "affine_grid", "rank_loss",
+    "margin_rank_loss", "pad2d", "sampling_id", "strided_slice", "maxout",
+    "space_to_depth", "affine_channel", "hash", "grid_sampler",
+    "add_position_encoding", "shuffle_channel", "temporal_shift",
+    "kldiv_loss", "pixel_shuffle", "unique", "unique_with_counts",
+    "unfold", "shard_index", "bpr_loss", "cross_entropy2", "random_crop",
+    "similarity_focus", "teacher_student_sigmoid_loss", "roi_pool",
+    "roi_align", "mean_iou", "bilinear_tensor_product", "multiplex",
+    "im2sequence", "row_conv", "selu", "stanh", "brelu", "sign",
+    "elementwise_mod", "elementwise_floordiv", "sum", "rank", "size",
+    "dice_loss", "mse_loss", "npair_loss", "image_resize_short",
+    "fsp_matrix", "uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like", "maxout", "center_loss",
+    "data_norm", "spectral_norm", "deformable_conv", "deformable_roi_pooling",
+    "psroi_pool", "prroi_pool", "merge_selected_rows",
+    "get_tensor_from_selected_rows", "continuous_value_model",
+    "sampled_softmax_with_cross_entropy", "py_func", "resize_trilinear",
+    "lstm_unit", "autoincreased_step_counter", "adaptive_pool3d",
+    "beam_search", "beam_search_decode", "filter_by_instag",
+]
+
+
+def _one(op_type, inputs, attrs=None, dtype=None, n_out=1, out_slot="Out",
+         extra_outs=(), name=None):
+    """Generic single-main-output wrapper."""
+    helper = LayerHelper(op_type, name=name)
+    first = next(v for v in inputs.values()
+                 if v is not None and not isinstance(v, (list, tuple)))
+    dtype = dtype or first.dtype
+    out = helper.create_variable_for_type_inference(dtype)
+    outs = {out_slot: out}
+    extras = []
+    for slot, dt in extra_outs:
+        ev = helper.create_variable_for_type_inference(dt or dtype,
+                                                       stop_gradient=True)
+        outs[slot] = ev
+        extras.append(ev)
+    helper.append_op(op_type,
+                     inputs={k: v for k, v in inputs.items()
+                             if v is not None},
+                     outputs=outs, attrs=attrs or {})
+    return (out, *extras) if extras else out
+
+
+def _triple(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+
+# -- 3D conv/pool -----------------------------------------------------------
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    helper = LayerHelper("conv3d", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    k = _triple(filter_size)
+    num_channels = input.shape[1]
+    std = (2.0 / (k[0] * k[1] * k[2] * num_channels)) ** 0.5
+    w = helper.create_parameter(
+        helper.param_attr, shape=[num_filters, num_channels // groups] + k,
+        dtype=input.dtype, default_initializer=Normal(0.0, std))
+    pre_bias = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv3d", inputs={"Input": input, "Filter": w},
+                     outputs={"Output": pre_bias},
+                     attrs={"strides": _triple(stride),
+                            "paddings": _triple(padding),
+                            "dilations": _triple(dilation),
+                            "groups": groups})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, name=None):
+    return _one("pool3d", {"X": input},
+                {"pooling_type": pool_type, "ksize": _triple(pool_size),
+                 "strides": _triple(pool_stride),
+                 "paddings": _triple(pool_padding),
+                 "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+                 "exclusive": exclusive}, name=name)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv3d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    k = _triple(filter_size)
+    num_channels = input.shape[1]
+    w = helper.create_parameter(
+        helper.param_attr, shape=[num_channels, num_filters // groups] + k,
+        dtype=input.dtype, default_initializer=Xavier())
+    pre_bias = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv3d_transpose",
+                     inputs={"Input": input, "Filter": w},
+                     outputs={"Output": pre_bias},
+                     attrs={"strides": _triple(stride),
+                            "paddings": _triple(padding),
+                            "dilations": _triple(dilation),
+                            "groups": groups})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    if require_index:
+        raise NotImplementedError(
+            "adaptive_pool2d(require_index=True): XLA has no argmax-index "
+            "pooling output; take argmax over unfold-ed windows instead")
+    ps = pool_size if isinstance(pool_size, (list, tuple)) \
+        else [pool_size, pool_size]
+    return _one("pool2d", {"X": input},
+                {"pooling_type": pool_type, "ksize": list(ps),
+                 "adaptive": True}, name=name)
+
+
+# -- image / tensor rearrangement ------------------------------------------
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    out, _ = _one("lrn", {"X": input}, {"n": n, "k": k, "alpha": alpha,
+                                        "beta": beta},
+                  extra_outs=[("MidOut", None)], name=name)
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return _one("pad_constant_like", {"X": x, "Y": y},
+                {"pad_value": float(pad_value)}, name=name)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    return _one("label_smooth", {"X": label, "PriorDist": prior_dist},
+                {"epsilon": float(epsilon)}, name=name)
+
+
+def gather_nd(input, index, name=None):
+    return _one("gather_nd", {"X": input, "Index": index}, name=name)
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    return _one("scatter_nd_add",
+                {"X": ref, "Index": index, "Updates": updates}, name=name)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """Composite (reference nn.py scatter_nd): scatter_nd_add onto zeros."""
+    from .tensor import fill_constant
+
+    zero = fill_constant(shape=list(shape), dtype=updates.dtype, value=0.0)
+    return scatter_nd_add(zero, index, updates, name=name)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    attrs = {}
+    ins = {"X": x}
+    if isinstance(shape, (list, tuple)):
+        attrs["shape"] = list(shape)
+    elif shape is not None:
+        ins["Y"] = shape
+    if isinstance(offsets, (list, tuple)):
+        attrs["offsets"] = list(offsets)
+    elif offsets is not None:
+        ins["Offsets"] = offsets
+    return _one("crop", ins, attrs, name=name)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    attrs = {}
+    ins = {"X": x}
+    if isinstance(shape, (list, tuple)):
+        attrs["shape"] = list(shape)
+    elif shape is not None:
+        ins["Shape"] = shape
+    if isinstance(offsets, (list, tuple)):
+        attrs["offsets"] = list(offsets)
+    elif offsets is not None:
+        ins["Offsets"] = offsets
+    return _one("crop_tensor", ins, attrs, name=name)
+
+
+def affine_grid(theta, out_shape=None, name=None):
+    attrs = {}
+    ins = {"Theta": theta}
+    if isinstance(out_shape, (list, tuple)):
+        attrs["output_shape"] = [int(v) for v in out_shape]
+    elif out_shape is not None:
+        ins["OutputShape"] = out_shape
+    return _one("affine_grid", ins, attrs, out_slot="Output", name=name)
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    return _one("pad2d", {"X": input},
+                {"paddings": list(paddings), "mode": mode,
+                 "pad_value": float(pad_value), "data_format": data_format},
+                name=name)
+
+
+def strided_slice(input, axes, starts, ends, strides, name=None):
+    return _one("strided_slice", {"Input": input},
+                {"axes": list(axes), "starts": list(starts),
+                 "ends": list(ends), "strides": list(strides)}, name=name)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return _one("maxout", {"X": x}, {"groups": groups, "axis": axis},
+                name=name)
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _one("space_to_depth", {"X": x}, {"blocksize": blocksize},
+                name=name)
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    return _one("affine_channel", {"X": x, "Scale": scale, "Bias": bias},
+                {"data_layout": data_layout}, name=name)
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    return _one("hash", {"X": input},
+                {"num_hash": num_hash, "mod_by": hash_size}, dtype="int64",
+                name=name)
+
+
+def grid_sampler(x, grid, name=None):
+    return _one("grid_sampler", {"X": x, "Grid": grid},
+                out_slot="Output", name=name)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    return _one("add_position_encoding", {"X": input},
+                {"alpha": float(alpha), "beta": float(beta)}, name=name)
+
+
+def shuffle_channel(x, group, name=None):
+    return _one("shuffle_channel", {"X": x}, {"group": group}, name=name)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _one("temporal_shift", {"X": x},
+                {"seg_num": seg_num, "shift_ratio": shift_ratio}, name=name)
+
+
+def pixel_shuffle(x, upscale_factor, name=None):
+    return _one("pixel_shuffle", {"X": x},
+                {"upscale_factor": upscale_factor}, name=name)
+
+
+def unique(x, dtype="int32", name=None):
+    return _one("unique", {"X": x}, {"dtype": dtype},
+                extra_outs=[("Index", dtype)], name=name)
+
+
+def unique_with_counts(x, dtype="int32", name=None):
+    return _one("unique_with_counts", {"X": x}, {"dtype": dtype},
+                extra_outs=[("Index", dtype), ("Count", dtype)], name=name)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    pair = lambda v: list(v) if isinstance(v, (list, tuple)) else [v, v]
+    return _one("unfold", {"X": x},
+                {"kernel_sizes": pair(kernel_sizes),
+                 "strides": pair(strides),
+                 "paddings": pair(paddings) if not isinstance(
+                     paddings, (list, tuple)) or len(paddings) != 4
+                 else list(paddings),
+                 "dilations": pair(dilations)}, out_slot="Y", name=name)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    return _one("shard_index", {"X": input},
+                {"index_num": index_num, "nshards": nshards,
+                 "shard_id": shard_id, "ignore_value": ignore_value},
+                name=name)
+
+
+def random_crop(x, shape, seed=None, name=None):
+    out, _ = _one("random_crop", {"X": x},
+                  {"shape": list(shape),
+                   "startup_seed": int(seed) if seed else 0},
+                  extra_outs=[("SeedOut", "int64")], name=name)
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    return _one("similarity_focus", {"X": input},
+                {"axis": axis, "indexes": list(indexes)}, name=name)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64", name=None):
+    return _one("sampling_id", {"X": x},
+                {"min": min, "max": max, "seed": seed}, dtype=dtype,
+                name=name)
+
+
+# -- losses -----------------------------------------------------------------
+
+def rank_loss(label, left, right, name=None):
+    return _one("rank_loss", {"Label": label, "Left": left, "Right": right},
+                name=name)
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    out, _ = _one("margin_rank_loss",
+                  {"Label": label, "X1": left, "X2": right},
+                  {"margin": float(margin)},
+                  extra_outs=[("Activated", None)], name=name)
+    return out
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    return _one("kldiv_loss", {"X": x, "Target": target},
+                {"reduction": reduction}, out_slot="Loss", name=name)
+
+
+def bpr_loss(input, label, name=None):
+    return _one("bpr_loss", {"X": input, "Label": label}, out_slot="Y",
+                name=name)
+
+
+def cross_entropy2(input, label, ignore_index=-100, name=None):
+    out, _, _ = _one("cross_entropy2", {"X": input, "Label": label},
+                     {"ignore_index": ignore_index}, out_slot="Y",
+                     extra_outs=[("XShape", None), ("MatchX", None)],
+                     name=name)
+    return out
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    return _one("teacher_student_sigmoid_loss",
+                {"X": input, "Label": label},
+                {"soft_max_up_bound": soft_max_up_bound,
+                 "soft_max_lower_bound": soft_max_lower_bound},
+                out_slot="Y")
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """Composite, reference nn.py dice_loss: 1 - 2|X*Y| / (|X|+|Y|)."""
+    label = _nn.one_hot(label, input.shape[-1])
+    reduce_dims = list(range(1, len(input.shape)))
+    inse = _nn.reduce_sum(_nn.elementwise_mul(input, label),
+                          dim=reduce_dims)
+    dice_denominator = _nn.elementwise_add(
+        _nn.reduce_sum(input, dim=reduce_dims),
+        _nn.reduce_sum(label, dim=reduce_dims))
+    dice_score = _nn.scale(
+        _nn.elementwise_div(
+            inse, _nn.scale(dice_denominator, scale=1.0, bias=epsilon)),
+        scale=-2.0, bias=1.0)
+    return _nn.reduce_mean(dice_score)
+
+
+def mse_loss(input, label):
+    """Composite, reference nn.py mse_loss."""
+    return _nn.reduce_mean(_nn.square_error_cost(input, label))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Composite, reference nn.py npair_loss (multi-class N-pair loss)."""
+    batch = anchor.shape[0]
+    labels = _nn.reshape(_nn.cast(labels, "float32"), [-1, 1])
+    same = _nn.cast(_nn.equal(labels, _nn.transpose(labels, [1, 0])),
+                    "float32")
+    targets = _nn.elementwise_div(
+        same, _nn.reduce_sum(same, dim=1, keep_dim=True))
+    logits = _nn.matmul(anchor, positive, transpose_y=True)
+    softmax_ce = _nn.reduce_mean(_nn.reduce_sum(
+        _nn.elementwise_mul(_nn.scale(targets, scale=-1.0),
+                            _nn.log_softmax(logits)), dim=1))
+    reg = _nn.scale(
+        _nn.elementwise_add(_nn.reduce_mean(_nn.reduce_sum(
+            _nn.square(anchor), dim=1)),
+            _nn.reduce_mean(_nn.reduce_sum(_nn.square(positive), dim=1))),
+        scale=float(l2_reg) * 0.25)
+    return _nn.elementwise_add(softmax_ce, reg)
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    helper = LayerHelper("center_loss", param_attr=param_attr)
+    centers = helper.create_parameter(
+        helper.param_attr, shape=[num_classes, input.shape[-1]],
+        dtype=input.dtype, default_initializer=Constant(0.0))
+    rate = helper.create_variable_for_type_inference("float32",
+                                                     stop_gradient=True)
+    helper.append_op("fill_constant", outputs={"Out": rate},
+                     attrs={"shape": [1], "dtype": "float32",
+                            "value": float(alpha)})
+    c_out = helper.create_variable_for_type_inference(input.dtype,
+                                                      stop_gradient=True)
+    diff = helper.create_variable_for_type_inference(input.dtype,
+                                                     stop_gradient=True)
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("center_loss",
+                     inputs={"X": input, "Label": label,
+                             "Centers": centers, "CenterUpdateRate": rate},
+                     outputs={"CentersOut": c_out, "SampleCenterDiff": diff,
+                              "Loss": loss},
+                     attrs={"cluster_num": num_classes,
+                            "need_update": update_center})
+    return loss
+
+
+# -- misc surface -----------------------------------------------------------
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_batch_idx=None, name=None):
+    out, _ = _one("roi_pool",
+                  {"X": input, "ROIs": rois,
+                   "RoisBatchIdx": rois_batch_idx},
+                  {"pooled_height": pooled_height,
+                   "pooled_width": pooled_width,
+                   "spatial_scale": spatial_scale},
+                  extra_outs=[("Argmax", "int64")], name=name)
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_batch_idx=None,
+              name=None):
+    return _one("roi_align",
+                {"X": input, "ROIs": rois, "RoisBatchIdx": rois_batch_idx},
+                {"pooled_height": pooled_height,
+                 "pooled_width": pooled_width,
+                 "spatial_scale": spatial_scale,
+                 "sampling_ratio": sampling_ratio}, name=name)
+
+
+def mean_iou(input, label, num_classes):
+    out, wrong, correct = _one(
+        "mean_iou", {"Predictions": input, "Labels": label},
+        {"num_classes": num_classes}, dtype="float32",
+        out_slot="OutMeanIou",
+        extra_outs=[("OutWrong", "int32"), ("OutCorrect", "int32")])
+    return out, wrong, correct
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    w = helper.create_parameter(
+        helper.param_attr, shape=[size, x.shape[-1], y.shape[-1]],
+        dtype=x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": x, "Y": y, "Weight": w}
+    if bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr or ParamAttr(),
+                                    shape=[1, size], dtype=x.dtype,
+                                    is_bias=True)
+        ins["Bias"] = b
+    helper.append_op("bilinear_tensor_product", inputs=ins,
+                     outputs={"Out": out})
+    return helper.append_activation(out)
+
+
+def multiplex(inputs, index):
+    return _one("multiplex", {"Ids": index, "X": list(inputs)})
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    pair = lambda v: list(v) if isinstance(v, (list, tuple)) else [v, v]
+    pads = pair(padding)
+    if len(pads) == 2:
+        pads = pads + pads
+    return _one("im2sequence", {"X": input, "Y": input_image_size},
+                {"kernels": pair(filter_size), "strides": pair(stride),
+                 "paddings": pads, "out_stride": pair(out_stride)},
+                name=name)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    w = helper.create_parameter(
+        helper.param_attr,
+        shape=[future_context_size + 1, input.shape[-1]],
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("row_conv", inputs={"X": input, "Filter": w},
+                     outputs={"Out": out})
+    return helper.append_activation(out)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, name=None):
+    helper = LayerHelper("data_norm", param_attr=param_attr, act=act,
+                         name=name)
+    c = input.shape[-1]
+    mk = lambda n, v: helper.create_parameter(
+        ParamAttr(name=None), shape=[c], dtype=input.dtype,
+        default_initializer=Constant(v))
+    batch_size, batch_sum, batch_sq = mk("bs", 1e4), mk("bsum", 0.0), \
+        mk("bsq", 1e4)
+    y = helper.create_variable_for_type_inference(input.dtype)
+    means = helper.create_variable_for_type_inference(input.dtype, True)
+    scales = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("data_norm",
+                     inputs={"X": input, "BatchSize": batch_size,
+                             "BatchSum": batch_sum,
+                             "BatchSquareSum": batch_sq},
+                     outputs={"Y": y, "Means": means, "Scales": scales},
+                     attrs={"epsilon": epsilon})
+    return helper.append_activation(y)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    helper = LayerHelper("spectral_norm", name=name)
+    h = int(weight.shape[dim])
+    w = int(np.prod([weight.shape[i] for i in range(len(weight.shape))
+                     if i != dim]))
+    import paddle_tpu.unique_name as un
+
+    mk = lambda n, size: helper.create_parameter(
+        ParamAttr(name=un.generate(n), trainable=False), shape=[size],
+        dtype=weight.dtype, default_initializer=Normal(0.0, 1.0))
+    u, v = mk("spectral_norm_u", h), mk("spectral_norm_v", w)
+    out = helper.create_variable_for_type_inference(weight.dtype)
+    helper.append_op("spectral_norm",
+                     inputs={"Weight": weight, "U": u, "V": v},
+                     outputs={"Out": out},
+                     attrs={"dim": dim, "power_iters": power_iters,
+                            "eps": eps})
+    return out
+
+
+def selu(x, scale=None, alpha=None, name=None):
+    attrs = {}
+    if scale is not None:
+        attrs["scale"] = scale
+    if alpha is not None:
+        attrs["alpha"] = alpha
+    return _one("selu", {"X": x}, attrs, name=name)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _one("stanh", {"X": x},
+                {"scale_a": scale_a, "scale_b": scale_b}, name=name)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _one("brelu", {"X": x}, {"t_min": t_min, "t_max": t_max},
+                name=name)
+
+
+def sign(x, name=None):
+    return _one("sign", {"X": x}, name=name)
+
+
+def elementwise_mod(x, y, axis=-1, name=None):
+    return _one("elementwise_mod", {"X": x, "Y": y}, {"axis": axis},
+                name=name)
+
+
+def elementwise_floordiv(x, y, axis=-1, name=None):
+    return _one("elementwise_floordiv", {"X": x, "Y": y}, {"axis": axis},
+                name=name)
+
+
+def sum(x):
+    ins = list(x) if isinstance(x, (list, tuple)) else [x]
+    return _one("sum", {"X": ins})
+
+
+def rank(input):
+    """Static rank as a constant tensor (reference nn.py rank)."""
+    from .tensor import fill_constant
+
+    return fill_constant(shape=[1], dtype="int32", value=len(input.shape))
+
+
+def size(input):
+    from .tensor import fill_constant
+
+    return fill_constant(shape=[1], dtype="int64",
+                         value=int(np.prod(
+                             [d for d in input.shape if d != -1])))
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Composite, reference nn.py image_resize_short: scale so the SHORT
+    side equals out_short_len (static shapes at build time)."""
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short = min(h, w)
+    out_h = int(round(h * out_short_len / short))
+    out_w = int(round(w * out_short_len / short))
+    return _nn.image_resize(input, [out_h, out_w], resample)
+
+
+def fsp_matrix(x, y):
+    from ..contrib.slim.distillation import fsp_matrix as _fsp
+
+    return _fsp(x, y)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32", min=-1.0,
+                                   max=1.0, seed=0, input_dim_idx=0,
+                                   output_dim_idx=0, name=None):
+    helper = LayerHelper("uniform_random_batch_size_like", name=name)
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    stop_gradient=True)
+    sh = list(shape)
+    sh[output_dim_idx] = -1  # batch-sized at runtime
+    helper.append_op("uniform_random_batch_size_like",
+                     inputs={"Input": input}, outputs={"Out": out},
+                     attrs={"shape": sh, "min": min, "max": max,
+                            "seed": seed, "dtype": dtype,
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, dtype="float32",
+                                    mean=0.0, std=1.0, seed=0,
+                                    input_dim_idx=0, output_dim_idx=0,
+                                    name=None):
+    helper = LayerHelper("gaussian_random_batch_size_like", name=name)
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    stop_gradient=True)
+    sh = list(shape)
+    sh[output_dim_idx] = -1
+    helper.append_op("gaussian_random_batch_size_like",
+                     inputs={"Input": input}, outputs={"Out": out},
+                     attrs={"shape": sh, "mean": mean, "std": std,
+                            "seed": seed, "dtype": dtype,
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+# -- round-5 tail: deformable family, sequence tail, host callback ----------
+
+def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
+                    padding=0, dilation=1, groups=1, deformable_groups=1,
+                    im2col_step=64, param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    """reference nn.py deformable_conv (v2 when modulated/mask given)."""
+    helper = LayerHelper("deformable_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    pair = lambda v: list(v) if isinstance(v, (list, tuple)) else [v, v]
+    k = pair(filter_size)
+    num_channels = input.shape[1]
+    std = (2.0 / (k[0] * k[1] * num_channels)) ** 0.5
+    w = helper.create_parameter(
+        helper.param_attr,
+        shape=[num_filters, num_channels // groups] + k,
+        dtype=input.dtype, default_initializer=Normal(0.0, std))
+    pre_bias = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Input": input, "Offset": offset, "Filter": w}
+    if modulated and mask is not None:
+        ins["Mask"] = mask
+    helper.append_op("deformable_conv", inputs=ins,
+                     outputs={"Output": pre_bias},
+                     attrs={"strides": pair(stride),
+                            "paddings": pair(padding),
+                            "dilations": pair(dilation), "groups": groups,
+                            "deformable_groups": deformable_groups,
+                            "im2col_step": im2col_step})
+    return helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1,
+                           part_size=None, sample_per_part=1, trans_std=0.1,
+                           position_sensitive=True, name=None):
+    helper = LayerHelper("deformable_psroi_pooling", name=name)
+    if not position_sensitive:
+        raise NotImplementedError(
+            "deformable_roi_pooling(position_sensitive=False): use "
+            "roi_align + trans offsets; the PS path is the deformable "
+            "detectors' configuration")
+    gs = list(group_size)
+    out_dim = input.shape[1] // (gs[0] * gs[1])
+    ps = list(part_size) if part_size is not None \
+        else [pooled_height, pooled_width]
+    o = helper.create_variable_for_type_inference(input.dtype)
+    cnt = helper.create_variable_for_type_inference(input.dtype,
+                                                    stop_gradient=True)
+    helper.append_op("deformable_psroi_pooling",
+                     inputs={"Input": input, "ROIs": rois, "Trans": trans},
+                     outputs={"Output": o, "TopCount": cnt},
+                     attrs={"no_trans": no_trans,
+                            "spatial_scale": spatial_scale,
+                            "output_dim": int(out_dim), "group_size": gs,
+                            "pooled_height": pooled_height,
+                            "pooled_width": pooled_width, "part_size": ps,
+                            "sample_per_part": sample_per_part,
+                            "trans_std": trans_std})
+    return o
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    return _one("psroi_pool", {"X": input, "ROIs": rois},
+                {"output_channels": output_channels,
+                 "spatial_scale": spatial_scale,
+                 "pooled_height": pooled_height,
+                 "pooled_width": pooled_width}, name=name)
+
+
+def prroi_pool(input, rois, output_channels=None, spatial_scale=1.0,
+               pooled_height=1, pooled_width=1, name=None):
+    return _one("prroi_pool", {"X": input, "ROIs": rois},
+                {"spatial_scale": spatial_scale,
+                 "pooled_height": pooled_height,
+                 "pooled_width": pooled_width}, name=name)
+
+
+def merge_selected_rows(x, name=None):
+    return _one("merge_selected_rows", {"X": x}, name=name)
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    return _one("get_tensor_from_selected_rows", {"X": x}, name=name)
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    return _one("cvm", {"X": input, "CVM": cvm}, {"use_cvm": use_cvm},
+                out_slot="Y")
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    if use_customized_samples:
+        raise NotImplementedError(
+            "sampled_softmax_with_cross_entropy(use_customized_samples): "
+            "host-side alias tables; use the log-uniform sampler")
+    out_loss, _, _ = _one(
+        "sampled_softmax_with_cross_entropy",
+        {"Logits": logits, "Label": label},
+        {"num_samples": num_samples, "seed": seed,
+         "remove_accidental_hits": remove_accidental_hits},
+        out_slot="Loss",
+        extra_outs=[("Samples", "int64"), ("Probabilities", None)])
+    return out_loss
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference nn.py py_func: host python inside the graph, via
+    jax.pure_callback. ``out`` vars carry the result shapes/dtypes (they
+    must be created with concrete shapes). backward_func is unsupported —
+    the callback is opaque to autodiff."""
+    from ..ops.misc2 import register_py_func
+
+    if backward_func is not None:
+        raise NotImplementedError(
+            "py_func(backward_func=...): the host callback is opaque to "
+            "vjp; compute the backward inside the program instead")
+    helper = LayerHelper("py_func")
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    fid = register_py_func(func)
+    helper.append_op(
+        "py_func", inputs={"X": xs}, outputs={"Out": outs},
+        attrs={"func_id": fid,
+               "out_shapes": [[int(d) for d in v.shape] for v in outs],
+               "out_dtypes": [str(v.dtype) for v in outs]})
+    return out
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     align_corners=True):
+    if out_shape is None:
+        d, h, w = [int(s * scale) for s in input.shape[2:]]
+    else:
+        d, h, w = [int(v) for v in out_shape]
+    return _one("trilinear_interp", {"X": input},
+                {"out_d": d, "out_h": h, "out_w": w,
+                 "align_corners": align_corners}, name=name)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Composite (reference nn.py lstm_unit): one LSTM cell step built from
+    fc over [x_t, h_prev] + the gate math."""
+    concat_in = _nn.concat([x_t, hidden_t_prev], axis=1)
+    hidden = hidden_t_prev.shape[-1]
+    gates = _nn.fc(concat_in, 4 * hidden, param_attr=param_attr,
+                   bias_attr=bias_attr)
+    i, f, c_hat, o = _nn.split(gates, 4, dim=-1)
+    f_act = _nn.sigmoid(_nn.scale(f, scale=1.0, bias=float(forget_bias)))
+    new_cell = _nn.elementwise_add(
+        _nn.elementwise_mul(f_act, cell_t_prev),
+        _nn.elementwise_mul(_nn.sigmoid(i), _nn.tanh(c_hat)))
+    new_hidden = _nn.elementwise_mul(_nn.sigmoid(o), _nn.tanh(new_cell))
+    return new_hidden, new_cell
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """reference nn.py autoincreased_step_counter: a persistable counter
+    advanced by ``step`` each iteration, one counter per name."""
+    from ..framework import default_main_program, default_startup_program
+
+    name = counter_name or "@STEP_COUNTER@"
+    main = default_main_program().global_block
+    startup = default_startup_program().global_block
+    if not main.has_var(name):
+        main.create_var(name=name, shape=(1,), dtype="int64",
+                        persistable=True, stop_gradient=True)
+        startup.create_var(name=name, shape=(1,), dtype="int64",
+                           persistable=True)
+        startup.append_op("fill_constant", outputs={"Out": name},
+                          attrs={"shape": [1], "dtype": "int64",
+                                 "value": float(begin) - float(step)})
+        main.prepend_op("increment", inputs={"X": name},
+                        outputs={"Out": name},
+                        attrs={"step": float(step),
+                               "__op_role__": "lr_sched"})
+    return main.var(name)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    if require_index:
+        raise NotImplementedError("adaptive_pool3d(require_index=True)")
+    d, h, w = [int(v) for v in input.shape[2:]]
+    ps = _triple(pool_size)
+    if d % ps[0] or h % ps[1] or w % ps[2]:
+        raise NotImplementedError(
+            "adaptive_pool3d: input spatial dims must divide pool_size on "
+            "TPU (static windows); pad the input or pick a divisor size")
+    k = [d // ps[0], h // ps[1], w // ps[2]]
+    return pool3d(input, pool_size=k, pool_type=pool_type, pool_stride=k)
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """reference nn.py beam_search — wrapper over the beam_search op the
+    seq2seq model drives inside While (models/seq2seq.py)."""
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_variable_for_type_inference("int64")
+    sel_scores = helper.create_variable_for_type_inference(
+        pre_scores.dtype)
+    parent = helper.create_variable_for_type_inference("int64",
+                                                       stop_gradient=True)
+    ins = {"pre_ids": pre_ids, "pre_scores": pre_scores, "scores": scores}
+    if ids is not None:
+        ins["ids"] = ids
+    helper.append_op("beam_search", inputs=ins,
+                     outputs={"selected_ids": sel_ids,
+                              "selected_scores": sel_scores,
+                              "parent_idx": parent},
+                     attrs={"beam_size": beam_size, "end_id": end_id,
+                            "level": level,
+                            "is_accumulated": is_accumulated})
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None):
+    """reference nn.py beam_search_decode: backtrack the per-step beam
+    arrays into full sentences."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    s_ids = helper.create_variable_for_type_inference("int64")
+    s_scores = helper.create_variable_for_type_inference("float32")
+    helper.append_op("beam_search_decode",
+                     inputs={"Ids": ids, "Scores": scores},
+                     outputs={"SentenceIds": s_ids,
+                              "SentenceScores": s_scores},
+                     attrs={"beam_size": beam_size, "end_id": end_id})
+    return s_ids, s_scores
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True):
+    raise NotImplementedError(
+        "filter_by_instag selects variable-size row subsets at runtime — "
+        "dynamic shapes XLA cannot compile. Filter in the data pipeline "
+        "(reader decorators) or mask rows with sequence_mask instead.")

@@ -246,3 +246,75 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     helper.append_op("sequence_mask", inputs={"X": x}, outputs={"Y": out},
                      attrs={"maxlen": int(maxlen or -1), "out_dtype": dtype})
     return out
+
+
+def sequence_reshape(input, new_dim):
+    """reference nn.py sequence_reshape: redistribute timesteps so the
+    feature dim becomes new_dim; lengths scale by old_dim/new_dim."""
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    lod = _make_lod_out(helper, out)
+    helper.append_op("sequence_reshape",
+                     inputs={"X": input, "SeqLen": seq_len_var(input)},
+                     outputs={"Out": out, "OutLen": lod},
+                     attrs={"new_dim": int(new_dim)})
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    """reference nn.py sequence_expand_as: row i of x fills sequence i of
+    y (padded encoding: broadcast over y's time axis, masked by lengths)."""
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    lod = _make_lod_out(helper, out)
+    helper.append_op("sequence_expand_as",
+                     inputs={"X": x, "Y": y, "SeqLen": seq_len_var(y)},
+                     outputs={"Out": out})
+    helper.append_op("assign", inputs={"X": seq_len_var(y)},
+                     outputs={"Out": lod})
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_scatter",
+                     inputs={"X": input, "Ids": index, "Updates": updates,
+                             "SeqLen": seq_len_var(index)},
+                     outputs={"Out": out})
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """reference nn.py lod_reset: re-bind x's sequence lengths. With the
+    padded+lengths encoding this is a companion-var rebind: lengths come
+    from y's companion (or y itself when y is int32 [batch]) or from the
+    static target_lod offsets."""
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("assign", inputs={"X": x}, outputs={"Out": out})
+    lod = _make_lod_out(helper, out)
+    if y is not None:
+        src = y if getattr(y, "lod_level", 0) == 0 and \
+            str(y.dtype).startswith("int") else seq_len_var(y)
+        helper.append_op("assign", inputs={"X": src}, outputs={"Out": lod})
+    elif target_lod is not None:
+        lens = [int(b) - int(a) for a, b in
+                zip(target_lod[:-1], target_lod[1:])]
+        helper.append_op("assign_value", outputs={"Out": lod},
+                         attrs={"shape": [len(lens)], "dtype": "int32",
+                                "values": [float(v) for v in lens]})
+    else:
+        raise ValueError("lod_reset needs y or target_lod")
+    return out
+
+
+def lod_append(x, level):
+    raise NotImplementedError(
+        "lod_append: the padded+lengths encoding carries ONE sequence "
+        "level (layers/sequence.py module docstring); nested levels "
+        "flatten at the data layer — reshape the batch instead")
+
+
+__all__ += ["sequence_reshape", "sequence_expand_as", "sequence_scatter",
+            "lod_reset", "lod_append"]

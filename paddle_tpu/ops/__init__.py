@@ -15,6 +15,7 @@ from . import fused_attention  # noqa: F401
 from . import pipeline_op  # noqa: F401
 from . import image  # noqa: F401
 from . import misc  # noqa: F401
+from . import misc2  # noqa: F401
 from . import structured  # noqa: F401
 
 from ..core.registry import all_ops, get_op_def, has_op, register_op  # noqa: F401

@@ -51,7 +51,15 @@ unary("log10", jnp.log10)
 unary("sign", jnp.sign, grad=None)
 unary("silu", jax.nn.silu)
 unary("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)))
-unary("selu", jax.nn.selu)
+
+
+@register_op("selu", inputs=["X"], outputs=["Out"],
+             attrs={"scale": 1.0507009873554805, "alpha": 1.6732632423543772})
+def _selu(ctx, ins, attrs):
+    v = x(ins)
+    return out(attrs["scale"] * jnp.where(
+        v > 0, v, attrs["alpha"] * (jnp.exp(v) - 1.0)))
+
 
 
 @register_op("stanh", inputs=["X"], outputs=["Out"],

@@ -158,7 +158,8 @@ def _random_crop(ctx, ins, attrs):
     xv = x(ins, "X")
     shape = [int(s) for s in attrs["shape"]]
     k = len(shape)
-    key = ctx.rng()
+    key = (jax.random.key(int(attrs["startup_seed"]))
+           if attrs.get("startup_seed") else ctx.rng())
     starts = []
     for i, s in enumerate(shape):
         limit = xv.shape[xv.ndim - k + i] - s
@@ -542,13 +543,8 @@ def _tree_conv(ctx, ins, attrs):
             et = (m - d) / m
             xt = reach @ feat                              # [N, F]
             xl = reach @ (tmp[:, None] * feat)
-            # root slot (d=0): index=1, pclen=1 by construction of the
-            # reference patch -> tmp must read 0.5 there, which the xl
-            # term with per-node tmp violates; d=0 uses the root's OWN
-            # sibling data in the reference? No: construct_patch pushes
-            # the root as TreeNode(root, 1, 1, 0) -> tmp = 0.5. But at
-            # d=0 the eta_l/eta_r factors are (1-et)=0, so the term
-            # vanishes and per-node tmp is harmless.
+            # at d=0 (the root) eta_l/eta_r carry a (1-eta_t)=0 factor,
+            # so the per-node tmp value never contributes there
             el_x = (1 - et) * xl
             er_x = (1 - et) * xt - (1 - et) ** 2 * xl
             acc = acc + et * (xt @ w_t) + el_x @ w_l + er_x @ w_r

@@ -1,0 +1,426 @@
+"""Round-5 op tail: deformable convolution family, position-sensitive ROI
+pooling, SelectedRows utilities, host-callback py_func, sampled softmax,
+trilinear resize, and padded-encoding sequence reshape/expand_as.
+
+References: paddle/fluid/operators/deformable_conv_op.cu (v2, modulated),
+deformable_psroi_pooling_op.cu, psroi_pool_op.h, prroi_pool_op.h,
+math/sampled_id... (sampled_softmax_with_cross_entropy_op.cc), cvm_op.h,
+py_func_op.cc, merge_selected_rows_op.cc,
+get_tensor_from_selected_rows_op.cc, sequence_reshape_op.h,
+sequence_expand_as_op.h, interpolate_op trilinear path.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import IOSpec, out, register_op, x
+
+
+def _bilinear_sample(img, yy, xx):
+    """img [C, H, W], yy/xx arbitrary same-shaped float coords; zero outside
+    (the deformable-conv convention, deformable_conv_op.cu DmcnIm2colBilinear
+    with boundary zeroing)."""
+    c, h, w = img.shape
+    y0 = jnp.floor(yy)
+    x0 = jnp.floor(xx)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1 = yy - y0
+    wx1 = xx - x0
+    wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+
+    def tap(yi, xi, wt):
+        inside = (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        v = img[:, yc, xc]                       # [C, ...coords]
+        return v * (wt * inside)[None]
+
+    return (tap(y0, x0, wy0 * wx0) + tap(y0, x1, wy0 * wx1)
+            + tap(y1, x0, wy1 * wx0) + tap(y1, x1, wy1 * wx1))
+
+
+@register_op("deformable_conv",
+             inputs=[IOSpec("Input"), IOSpec("Offset"),
+                     IOSpec("Mask", optional=True), IOSpec("Filter")],
+             outputs=["Output"],
+             attrs={"strides": [1, 1], "paddings": [0, 0],
+                    "dilations": [1, 1], "groups": 1,
+                    "deformable_groups": 1, "im2col_step": 64})
+def _deformable_conv(ctx, ins, attrs):
+    """Deformable conv v2 (modulated when Mask given; v1 otherwise) —
+    reference deformable_conv_op.cu. Each kernel tap samples the input at
+    its regular grid position plus a learned offset via bilinear
+    interpolation; the im2col_step attr is a CUDA blocking knob with no XLA
+    analogue (accepted, ignored)."""
+    inp = x(ins, "Input")            # [B, C, H, W]
+    offset = x(ins, "Offset")        # [B, 2*dg*kh*kw, Ho, Wo]
+    mask = x(ins, "Mask")            # [B, dg*kh*kw, Ho, Wo] or None
+    filt = x(ins, "Filter")          # [O, C/g, kh, kw]
+    b, c, h, w = inp.shape
+    o, cg, kh, kw = filt.shape
+    g = int(attrs.get("groups", 1))
+    dg = int(attrs.get("deformable_groups", 1))
+    sh, sw = attrs["strides"]
+    ph, pw = attrs["paddings"]
+    dh, dw = attrs["dilations"]
+    ho, wo = offset.shape[2], offset.shape[3]
+    off = offset.reshape(b, dg, kh * kw, 2, ho, wo)
+    msk = (None if mask is None
+           else mask.reshape(b, dg, kh * kw, ho, wo))
+
+    oy = jnp.arange(ho) * sh - ph
+    ox = jnp.arange(wo) * sw - pw
+    base_y = oy[:, None]                          # [Ho, 1]
+    base_x = ox[None, :]                          # [1, Wo]
+    cpg = c // dg                                  # channels per dg group
+
+    def per_image(img, off_b, msk_b):
+        taps = []
+        for t in range(kh * kw):
+            i, j = t // kw, t % kw
+            groups_out = []
+            for d in range(dg):
+                yy = base_y + i * dh + off_b[d, t, 0]   # [Ho, Wo]
+                xx = base_x + j * dw + off_b[d, t, 1]
+                v = _bilinear_sample(img[d * cpg:(d + 1) * cpg], yy, xx)
+                if msk_b is not None:
+                    v = v * msk_b[d, t][None]
+                groups_out.append(v)
+            taps.append(jnp.concatenate(groups_out, axis=0))  # [C, Ho, Wo]
+        return jnp.stack(taps)                    # [kh*kw, C, Ho, Wo]
+
+    if msk is not None:
+        samp = jax.vmap(per_image)(inp, off, msk)
+    else:
+        samp = jax.vmap(lambda img, off_b: per_image(img, off_b, None))(
+            inp, off)
+    # grouped contraction: out[b,o,:,:] = sum_{c in group(o), t} w * samp
+    filt_t = filt.reshape(g, o // g, cg, kh * kw)
+    samp_g = samp.reshape(b, kh * kw, g, cg, ho, wo)
+    res = jnp.einsum("btgchw,goct->bgohw", samp_g, filt_t)
+    return {"Output": [res.reshape(b, o, ho, wo)]}
+
+
+@register_op("psroi_pool",
+             inputs=[IOSpec("X"), IOSpec("ROIs", no_grad=True),
+                     IOSpec("RoisBatchIdx", optional=True, no_grad=True)],
+             outputs=["Out"],
+             attrs={"output_channels": 1, "spatial_scale": 1.0,
+                    "pooled_height": 1, "pooled_width": 1}, grad=None)
+def _psroi_pool(ctx, ins, attrs):
+    """Position-sensitive ROI average pooling (reference psroi_pool_op.h):
+    output channel o's bin (i, j) averages input channel
+    o*ph*pw + i*pw + j over that bin's region."""
+    inp = x(ins, "X")                # [B, oc*ph*pw, H, W]
+    rois = x(ins, "ROIs")            # [R, 4]
+    bidx = x(ins, "RoisBatchIdx")
+    r = rois.shape[0]
+    bidx = (jnp.zeros((r,), jnp.int32) if bidx is None
+            else bidx.reshape(-1).astype(jnp.int32))
+    oc = int(attrs["output_channels"])
+    ph, pw = int(attrs["pooled_height"]), int(attrs["pooled_width"])
+    scale = float(attrs["spatial_scale"])
+    _, _, hh, ww = inp.shape
+
+    def one(roi, bi):
+        img = inp[bi]                               # [C, H, W]
+        x0 = jnp.round(roi[0] * scale)
+        y0 = jnp.round(roi[1] * scale)
+        x1 = jnp.round(roi[2] * scale) + 1.0
+        y1 = jnp.round(roi[3] * scale) + 1.0
+        rh = jnp.maximum(y1 - y0, 0.1) / ph
+        rw = jnp.maximum(x1 - x0, 0.1) / pw
+        outv = []
+        ys = jnp.arange(hh)
+        xs = jnp.arange(ww)
+        for i in range(ph):
+            for j in range(pw):
+                hs = jnp.floor(y0 + i * rh)
+                he = jnp.ceil(y0 + (i + 1) * rh)
+                ws_ = jnp.floor(x0 + j * rw)
+                we = jnp.ceil(x0 + (j + 1) * rw)
+                m = ((ys[:, None] >= hs) & (ys[:, None] < he)
+                     & (xs[None, :] >= ws_) & (xs[None, :] < we))
+                chans = jnp.arange(oc) * ph * pw + i * pw + j
+                region = img[chans]                 # [oc, H, W]
+                s = jnp.sum(region * m[None], axis=(1, 2))
+                cnt = jnp.maximum(jnp.sum(m), 1)
+                outv.append(s / cnt)
+        return jnp.stack(outv, 1).reshape(oc, ph, pw)
+
+    return out(jax.vmap(one)(rois, bidx))
+
+
+@register_op("prroi_pool",
+             inputs=[IOSpec("X"), IOSpec("ROIs", no_grad=True),
+                     IOSpec("BatchRoINums", optional=True, no_grad=True)],
+             outputs=["Out"],
+             attrs={"spatial_scale": 1.0, "pooled_height": 1,
+                    "pooled_width": 1, "sample_num": 4})
+def _prroi_pool(ctx, ins, attrs):
+    """Precise ROI pooling (reference prroi_pool_op.h). Deviation: the
+    reference integrates bilinear interpolation in closed form; here each
+    bin averages a dense sample_num x sample_num bilinear grid — converges
+    to the same value and keeps the op a fixed-shape gather program."""
+    inp = x(ins, "X")
+    rois = x(ins, "ROIs")
+    r = rois.shape[0]
+    bidx = jnp.zeros((r,), jnp.int32)
+    ph, pw = int(attrs["pooled_height"]), int(attrs["pooled_width"])
+    scale = float(attrs["spatial_scale"])
+    s = max(int(attrs.get("sample_num", 4)), 1)
+
+    def one(roi, bi):
+        img = inp[bi]
+        x0, y0 = roi[0] * scale, roi[1] * scale
+        x1, y1 = roi[2] * scale, roi[3] * scale
+        bw = jnp.maximum(x1 - x0, 1e-4) / pw
+        bh = jnp.maximum(y1 - y0, 1e-4) / ph
+        iy = jnp.arange(ph).reshape(ph, 1, 1, 1)
+        ix = jnp.arange(pw).reshape(1, pw, 1, 1)
+        sy = (jnp.arange(s).reshape(1, 1, s, 1) + 0.5) / s
+        sx = (jnp.arange(s).reshape(1, 1, 1, s) + 0.5) / s
+        yy = y0 + (iy + sy) * bh
+        xx = x0 + (ix + sx) * bw
+        v = _bilinear_sample(img, yy, xx)          # [C, ph, pw, s, s]
+        return v.mean(axis=(-2, -1))
+
+    return out(jax.vmap(one)(rois, bidx))
+
+
+@register_op("deformable_psroi_pooling",
+             inputs=[IOSpec("Input"), IOSpec("ROIs", no_grad=True),
+                     IOSpec("Trans")],
+             outputs=["Output", "TopCount"],
+             attrs={"no_trans": False, "spatial_scale": 1.0,
+                    "output_dim": 1, "group_size": [1, 1],
+                    "pooled_height": 1, "pooled_width": 1,
+                    "part_size": [1, 1], "sample_per_part": 4,
+                    "trans_std": 0.1})
+def _deformable_psroi_pooling(ctx, ins, attrs):
+    """Deformable PS-ROI pooling (reference
+    deformable_psroi_pooling_op.cu): each bin's sample grid is shifted by
+    the learned normalized Trans offsets before position-sensitive
+    averaging."""
+    inp = x(ins, "Input")            # [B, od*gh*gw, H, W]
+    rois = x(ins, "ROIs")            # [R, 4]
+    trans = x(ins, "Trans")          # [R, 2, part_h, part_w]
+    r = rois.shape[0]
+    bidx = jnp.zeros((r,), jnp.int32)
+    od = int(attrs["output_dim"])
+    gh, gw = [int(v) for v in attrs["group_size"]]
+    ph, pw = int(attrs["pooled_height"]), int(attrs["pooled_width"])
+    part_h, part_w = [int(v) for v in attrs["part_size"]]
+    spp = int(attrs["sample_per_part"])
+    scale = float(attrs["spatial_scale"])
+    t_std = float(attrs["trans_std"])
+    no_trans = bool(attrs.get("no_trans", False))
+
+    def one(roi, tr, bi):
+        img = inp[bi]
+        x0 = roi[0] * scale - 0.5
+        y0 = roi[1] * scale - 0.5
+        x1 = (roi[2] + 1.0) * scale - 0.5
+        y1 = (roi[3] + 1.0) * scale - 0.5
+        rw = jnp.maximum(x1 - x0, 0.1)
+        rh = jnp.maximum(y1 - y0, 0.1)
+        bw, bh = rw / pw, rh / ph
+        outv = jnp.zeros((od, ph, pw), inp.dtype)
+        cnt = jnp.zeros((ph, pw), inp.dtype)
+        sub_h = bh / spp
+        sub_w = bw / spp
+        for i in range(ph):
+            for j in range(pw):
+                pi = min(int(i * part_h / ph), part_h - 1)
+                pj = min(int(j * part_w / pw), part_w - 1)
+                if no_trans:
+                    dx = dy = 0.0
+                else:
+                    dx = tr[0, pi, pj] * t_std * rw
+                    dy = tr[1, pi, pj] * t_std * rh
+                sy = (y0 + i * bh + dy
+                      + (jnp.arange(spp)[:, None] + 0.5) * sub_h)
+                sx = (x0 + j * bw + dx
+                      + (jnp.arange(spp)[None, :] + 0.5) * sub_w)
+                yy = jnp.broadcast_to(sy, (spp, spp))
+                xx = jnp.broadcast_to(sx, (spp, spp))
+                gi = min(int(i * gh / ph), gh - 1)
+                gj = min(int(j * gw / pw), gw - 1)
+                chans = jnp.arange(od) * gh * gw + gi * gw + gj
+                v = _bilinear_sample(img[chans], yy, xx)   # [od, spp, spp]
+                outv = outv.at[:, i, j].set(v.mean(axis=(-2, -1)))
+                cnt = cnt.at[i, j].set(float(spp * spp))
+        return outv, cnt
+
+    res, cnts = jax.vmap(one)(rois, trans, bidx)
+    return {"Output": [res], "TopCount": [cnts]}
+
+
+# -- SelectedRows utilities -------------------------------------------------
+
+
+@register_op("merge_selected_rows", inputs=["X"], outputs=["Out"],
+             grad=None)
+def _merge_selected_rows(ctx, ins, attrs):
+    """reference merge_selected_rows_op.cc: sum duplicate rows. Our
+    SelectedRows are canonical (merged at creation), so this re-merges
+    only when handed raw rows; dense input passes through."""
+    from ..core.selected_rows import is_selected_rows, merge_rows
+
+    v = x(ins)
+    if is_selected_rows(v):
+        return out(merge_rows(v.rows, v.values, v.height))
+    return out(v)
+
+
+@register_op("get_tensor_from_selected_rows", inputs=["X"],
+             outputs=["Out"], grad=None)
+def _get_tensor_from_selected_rows(ctx, ins, attrs):
+    """reference get_tensor_from_selected_rows_op.cc: densify."""
+    from ..core.selected_rows import is_selected_rows
+
+    v = x(ins)
+    return out(v.to_dense() if is_selected_rows(v) else v)
+
+
+# -- CTR / sampling ---------------------------------------------------------
+
+
+@register_op("sampled_softmax_with_cross_entropy",
+             inputs=[IOSpec("Logits"), IOSpec("Label", no_grad=True)],
+             outputs=["Samples", "Probabilities", "Loss"],
+             attrs={"num_samples": 5, "seed": 0, "use_customized_samples":
+                    False, "remove_accidental_hits": True},
+             needs_rng=True)
+def _sampled_softmax_ce(ctx, ins, attrs):
+    """reference sampled_softmax_with_cross_entropy_op.cc: softmax CE over
+    the true class + num_samples log-uniform negatives, logits adjusted by
+    -log(expected count). Accidental hits (a sampled negative equal to the
+    true label) are masked out when remove_accidental_hits."""
+    logits = x(ins, "Logits")        # [B, C]
+    label = x(ins, "Label").reshape(-1).astype(jnp.int32)
+    b, c = logits.shape
+    ns = int(attrs["num_samples"])
+    key = (jax.random.key(int(attrs["seed"])) if attrs.get("seed")
+           else ctx.rng())
+    u = jax.random.uniform(key, (b, ns))
+    neg = jnp.clip((jnp.exp(u * math.log(c + 1.0)) - 1.0).astype(jnp.int32),
+                   0, c - 1)
+    samples = jnp.concatenate([label[:, None], neg], axis=1)  # [B, 1+ns]
+    q = jnp.log((samples + 2.0) / (samples + 1.0)) / math.log(c + 1.0)
+    picked = jnp.take_along_axis(logits, samples, axis=1) - jnp.log(q)
+    if attrs.get("remove_accidental_hits", True):
+        hit = (samples[:, 1:] == label[:, None])
+        picked = picked.at[:, 1:].add(jnp.where(hit, -1e20, 0.0))
+    lse = jax.nn.logsumexp(picked, axis=1, keepdims=True)
+    prob = jnp.exp(picked - lse)
+    loss = (lse[:, 0] - picked[:, 0]).reshape(b, 1)
+    return {"Samples": [samples.astype(jnp.int64)],
+            "Probabilities": [prob], "Loss": [loss]}
+
+
+# -- host callback ----------------------------------------------------------
+
+_PY_FUNCS = []
+
+
+def register_py_func(fn) -> int:
+    _PY_FUNCS.append(fn)
+    return len(_PY_FUNCS) - 1
+
+
+@register_op("py_func", inputs=[IOSpec("X", duplicable=True)],
+             outputs=[IOSpec("Out", duplicable=True)],
+             attrs={"func_id": 0, "out_shapes": [], "out_dtypes": []},
+             grad=None)
+def _py_func(ctx, ins, attrs):
+    """reference py_func_op.cc (host python callback inside the graph) —
+    on TPU this is jax.pure_callback: the compiled program stalls on the
+    host roundtrip, so this is a debugging/IO escape hatch, not a compute
+    path. backward_func is unsupported (the callback is opaque to vjp)."""
+    from ..core.types import np_dtype
+
+    fn = _PY_FUNCS[int(attrs["func_id"])]
+    shapes = attrs["out_shapes"]
+    dtypes = attrs["out_dtypes"]
+    result_shape = [jax.ShapeDtypeStruct(tuple(s), np_dtype(d))
+                    for s, d in zip(shapes, dtypes)]
+
+    def host_fn(*arrays):
+        res = fn(*arrays)
+        if not isinstance(res, (list, tuple)):
+            res = (res,)
+        return tuple(np.asarray(r) for r in res)
+
+    vals = [v for v in ins.get("X", []) if v is not None]
+    res = jax.pure_callback(host_fn, result_shape, *vals)
+    return {"Out": list(res)}
+
+
+# -- resize / sequence tail -------------------------------------------------
+
+
+@register_op("sequence_reshape",
+             inputs=[IOSpec("X"), IOSpec("SeqLen", no_grad=True)],
+             outputs=["Out", "OutLen"], attrs={"new_dim": 1})
+def _sequence_reshape(ctx, ins, attrs):
+    """reference sequence_reshape_op.h on the padded encoding: [B, T, D]
+    -> [B, T*D/new_dim, new_dim]; lengths scale by D/new_dim."""
+    xv = x(ins, "X")
+    ln = x(ins, "SeqLen").reshape(-1).astype(jnp.int32)
+    b, t, d = xv.shape
+    nd = int(attrs["new_dim"])
+    if (t * d) % nd:
+        raise ValueError(f"sequence_reshape: T*D={t*d} not divisible by "
+                         f"new_dim={nd}")
+    new_len = (ln * d) // nd
+    return {"Out": [xv.reshape(b, (t * d) // nd, nd)],
+            "OutLen": [new_len]}
+
+
+@register_op("sequence_expand_as",
+             inputs=[IOSpec("X"), IOSpec("Y", no_grad=True),
+                     IOSpec("SeqLen", no_grad=True)],
+             outputs=["Out"], attrs={})
+def _sequence_expand_as(ctx, ins, attrs):
+    """reference sequence_expand_as_op.h: row i of X repeats to fill
+    sequence i of Y. Padded encoding: X [B, K] broadcasts over Y's time
+    axis, zeroed past each length."""
+    xv = x(ins, "X")
+    yv = x(ins, "Y")
+    ln = x(ins, "SeqLen").reshape(-1).astype(jnp.int32)
+    t = yv.shape[1]
+    if xv.ndim == 1:
+        xv = xv[:, None]
+    expanded = jnp.broadcast_to(xv[:, None, :],
+                                (xv.shape[0], t, xv.shape[-1]))
+    mask = (jnp.arange(t)[None, :] < ln[:, None])[..., None]
+    return out(jnp.where(mask, expanded, 0))
+
+
+@register_op("sequence_scatter",
+             inputs=[IOSpec("X"), IOSpec("Ids", no_grad=True),
+                     IOSpec("Updates"), IOSpec("SeqLen", no_grad=True)],
+             outputs=["Out"], attrs={})
+def _sequence_scatter(ctx, ins, attrs):
+    """reference sequence_scatter_op.h on the padded encoding: for each
+    batch row b, Out[b, Ids[b, t]] += Updates[b, t] for t < len(b)."""
+    xv = x(ins, "X")                  # [B, D]
+    ids = x(ins, "Ids")
+    upd = x(ins, "Updates")
+    ln = x(ins, "SeqLen").reshape(-1).astype(jnp.int32)
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    if upd.ndim == 3 and upd.shape[-1] == 1:
+        upd = upd[..., 0]
+    b, t = ids.shape
+    d = xv.shape[1]
+    valid = jnp.arange(t)[None, :] < ln[:, None]
+    tgt = jnp.where(valid, jnp.clip(ids.astype(jnp.int32), 0, d - 1), d)
+    bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+    return out(xv.at[bidx, tgt].add(
+        jnp.where(valid, upd, 0).astype(xv.dtype), mode="drop"))

@@ -499,3 +499,39 @@ def _shard_index(ctx, ins, attrs):
     local = v - sid * per
     ok = (v // per) == sid
     return out(jnp.where(ok, local, attrs["ignore_value"]))
+
+
+@register_op("uniform_random_batch_size_like", inputs=[IOSpec("Input", no_grad=True)],
+             outputs=["Out"],
+             attrs={"shape": [], "min": -1.0, "max": 1.0, "seed": 0,
+                    "dtype": "float32", "input_dim_idx": 0,
+                    "output_dim_idx": 0},
+             needs_rng=True, grad=None)
+def _uniform_random_bsl(ctx, ins, attrs):
+    """reference uniform_random_batch_size_like_op.cc: shape attr with one
+    dim replaced by Input's dim at input_dim_idx (static under XLA)."""
+    inp = x(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        inp.shape[attrs.get("input_dim_idx", 0)]
+    key = jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng()
+    return out(jax.random.uniform(key, tuple(shape),
+                                  dtype=np_dtype(attrs["dtype"]),
+                                  minval=attrs["min"], maxval=attrs["max"]))
+
+
+@register_op("gaussian_random_batch_size_like",
+             inputs=[IOSpec("Input", no_grad=True)], outputs=["Out"],
+             attrs={"shape": [], "mean": 0.0, "std": 1.0, "seed": 0,
+                    "dtype": "float32", "input_dim_idx": 0,
+                    "output_dim_idx": 0},
+             needs_rng=True, grad=None)
+def _gaussian_random_bsl(ctx, ins, attrs):
+    inp = x(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        inp.shape[attrs.get("input_dim_idx", 0)]
+    key = jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng()
+    return out(attrs["mean"] + attrs["std"]
+               * jax.random.normal(key, tuple(shape),
+                                   dtype=np_dtype(attrs["dtype"])))

@@ -1,0 +1,256 @@
+"""Round-5 layer-surface tail (layers/nn2.py + ops/misc2.py): deformable
+conv family, PS-ROI pooling, sampled softmax, py_func host callback,
+SelectedRows utilities, sequence reshape/expand_as/scatter, lstm_unit,
+and spot checks across the generic wrappers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+RNG = np.random.RandomState(9)
+
+
+def _run(main, feed, fetch, startup=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        if startup is not None:
+            exe.run(startup)
+        res = exe.run(main, feed=feed, fetch_list=fetch)
+    return [np.asarray(r) for r in res], scope
+
+
+def test_deformable_conv_zero_offset_equals_conv2d():
+    """With offsets=0 and mask=1, deformable conv v2 IS standard conv —
+    the exact oracle the reference kernels satisfy."""
+    b, c, h, w, o, k = 2, 4, 6, 6, 3, 3
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        img = fluid.layers.data("img", shape=[c, h, w], dtype="float32")
+        off = fluid.layers.data("off", shape=[2 * k * k, h, w],
+                                dtype="float32")
+        msk = fluid.layers.data("msk", shape=[k * k, h, w],
+                                dtype="float32")
+        y_def = fluid.layers.deformable_conv(
+            img, off, msk, num_filters=o, filter_size=k, padding=1,
+            param_attr=fluid.ParamAttr(name="w_def"), bias_attr=False)
+        y_ref = fluid.layers.conv2d(
+            img, o, k, padding=1,
+            param_attr=fluid.ParamAttr(name="w_ref"), bias_attr=False)
+        main = fluid.default_main_program()
+        xb = RNG.rand(b, c, h, w).astype(np.float32)
+        feed = {"img": xb,
+                "off": np.zeros((b, 2 * k * k, h, w), np.float32),
+                "msk": np.ones((b, k * k, h, w), np.float32)}
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            wv = RNG.randn(o, c, k, k).astype(np.float32) * 0.3
+            scope.set_var("w_def", wv)
+            scope.set_var("w_ref", wv)
+            got, ref = [np.asarray(v) for v in exe.run(
+                main, feed=feed, fetch_list=[y_def, y_ref])]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_offsets_shift_sampling():
+    """An integer offset of (0, +1) everywhere shifts sampling one pixel
+    right: equals conv over the shifted image (interior columns)."""
+    b, c, h, w, k = 1, 2, 6, 6, 1
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        img = fluid.layers.data("img", shape=[c, h, w], dtype="float32")
+        off = fluid.layers.data("off", shape=[2, h, w], dtype="float32")
+        y = fluid.layers.deformable_conv(
+            img, off, None, num_filters=1, filter_size=1, modulated=False,
+            param_attr=fluid.ParamAttr(name="w1"), bias_attr=False)
+        xb = RNG.rand(b, c, h, w).astype(np.float32)
+        offb = np.zeros((b, 2, h, w), np.float32)
+        offb[:, 1] = 1.0  # x-offset +1
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            wv = np.ones((1, c, 1, 1), np.float32)
+            scope.set_var("w1", wv)
+            got = np.asarray(exe.run(fluid.default_main_program(),
+                                     feed={"img": xb, "off": offb},
+                                     fetch_list=[y])[0])
+    expect = xb.sum(1)[:, None, :, 1:]  # shifted left by one in x
+    np.testing.assert_allclose(got[..., :-1], expect, rtol=1e-5, atol=1e-6)
+    assert np.allclose(got[..., -1], 0)  # sampled outside -> zero
+
+
+def test_psroi_pool_positions():
+    """2x2 PS pooling with oc=1: each bin reads its own channel."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xv = fluid.layers.data("x", shape=[4, 4, 4], dtype="float32")
+        rois = fluid.layers.data("rois", shape=[4], dtype="float32",
+                                 append_batch_size=False)
+        o = fluid.layers.psroi_pool(xv, rois, output_channels=1,
+                                    spatial_scale=1.0, pooled_height=2,
+                                    pooled_width=2)
+        xb = np.zeros((1, 4, 4, 4), np.float32)
+        for ch in range(4):
+            xb[0, ch] = ch + 1
+        feed = {"x": xb, "rois": np.array([[0, 0, 3, 3]], np.float32)}
+        got, _ = _run(fluid.default_main_program(), feed, [o])
+    np.testing.assert_allclose(got[0].reshape(2, 2),
+                               [[1, 2], [3, 4]], rtol=1e-5)
+
+
+def test_prroi_pool_uniform_image():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xv = fluid.layers.data("x", shape=[2, 6, 6], dtype="float32")
+        rois = fluid.layers.data("rois", shape=[4], dtype="float32",
+                                 append_batch_size=False)
+        o = fluid.layers.prroi_pool(xv, rois, spatial_scale=1.0,
+                                    pooled_height=2, pooled_width=2)
+        xb = np.full((1, 2, 6, 6), 3.5, np.float32)
+        feed = {"x": xb, "rois": np.array([[1, 1, 4, 4]], np.float32)}
+        got, _ = _run(fluid.default_main_program(), feed, [o])
+    np.testing.assert_allclose(got[0], 3.5, rtol=1e-4)
+
+
+def test_sampled_softmax_with_cross_entropy_trains():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xv = fluid.layers.data("x", shape=[8], dtype="float32")
+        yv = fluid.layers.data("y", shape=[1], dtype="int64")
+        logits = fluid.layers.fc(xv, 40)
+        loss = fluid.layers.mean(
+            fluid.layers.sampled_softmax_with_cross_entropy(
+                logits, yv, num_samples=8))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(0)
+        W = rng.randn(8, 40)
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            vals = []
+            for _ in range(60):
+                xb = rng.randn(32, 8).astype(np.float32)
+                yb = (xb @ W).argmax(1)[:, None].astype(np.int64)
+                out = exe.run(fluid.default_main_program(),
+                              feed={"x": xb, "y": yb}, fetch_list=[loss])
+                vals.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    assert vals[-1] < 0.6 * vals[0], (vals[0], vals[-1])
+
+
+def test_py_func_host_callback():
+    def double_plus_one(a):
+        return (2.0 * a + 1.0).astype(np.float32)
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xv = fluid.layers.data("x", shape=[3], dtype="float32",
+                               append_batch_size=False)
+        blk = fluid.default_main_program().global_block
+        ov = blk.create_var(name="py_out", shape=(2, 3), dtype="float32")
+        fluid.layers.py_func(double_plus_one, xv, ov)
+        xb = RNG.rand(2, 3).astype(np.float32)
+        got, _ = _run(fluid.default_main_program(), {"x": xb}, ["py_out"])
+    np.testing.assert_allclose(got[0], 2 * xb + 1, rtol=1e-6)
+
+
+def test_selected_rows_utility_layers():
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        ids = fluid.layers.data(name="ids", shape=[4], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[20, 6], is_sparse=True,
+                                     param_attr=fluid.ParamAttr(name="tw"))
+        loss = fluid.layers.mean(emb)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        dense_grad = fluid.layers.get_tensor_from_selected_rows(
+            fluid.layers.merge_selected_rows(
+                fluid.default_main_program().global_block.var("tw@GRAD")))
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            got = np.asarray(exe.run(
+                fluid.default_main_program(),
+                feed={"ids": np.array([[1, 2, 2, 5]], np.int64)},
+                fetch_list=[dense_grad])[0])
+    assert got.shape == (20, 6)
+    assert (np.abs(got[[1, 2, 5]]).sum(1) > 0).all()
+    untouched = np.ones(20, bool)
+    untouched[[1, 2, 5]] = False
+    assert (got[untouched] == 0).all()
+
+
+def test_sequence_reshape_and_expand_as_and_scatter():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xv = fluid.layers.data("x", shape=[4], dtype="float32",
+                               lod_level=1)
+        r = fluid.layers.sequence_reshape(xv, new_dim=2)
+        row = fluid.layers.data("row", shape=[3], dtype="float32")
+        e = fluid.layers.sequence_expand_as(row, xv)
+        base = fluid.layers.data("base", shape=[6], dtype="float32")
+        idx = fluid.layers.data("idx", shape=[1], dtype="int64",
+                                lod_level=1)
+        upd = fluid.layers.data("upd", shape=[1], dtype="float32",
+                                lod_level=1)
+        sc = fluid.layers.sequence_scatter(base, idx, upd)
+        feed = {
+            "x": RNG.rand(2, 3, 4).astype(np.float32),
+            "x@LOD": np.array([3, 2], np.int32),
+            "row": RNG.rand(2, 3).astype(np.float32),
+            "base": np.zeros((2, 6), np.float32),
+            "idx": np.array([[[0], [2], [2]], [[5], [1], [0]]], np.int64),
+            "idx@LOD": np.array([3, 2], np.int32),
+            "upd": np.ones((2, 3, 1), np.float32),
+            "upd@LOD": np.array([3, 2], np.int32),
+        }
+        got, _ = _run(fluid.default_main_program(), feed, [r, e, sc])
+    assert got[0].shape == (2, 6, 2)        # T*D/new_dim = 3*4/2
+    assert got[1].shape == (2, 3, 3)
+    assert (got[1][0, :3] == got[1][0, 0]).all()
+    assert (got[1][1, 2] == 0).all()        # beyond len 2 -> zero
+    np.testing.assert_allclose(got[2][0], [1, 0, 2, 0, 0, 0])
+    np.testing.assert_allclose(got[2][1], [0, 1, 0, 0, 0, 1])
+
+
+def test_lstm_unit_composite():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xt = fluid.layers.data("xt", shape=[5], dtype="float32")
+        h0 = fluid.layers.data("h0", shape=[4], dtype="float32")
+        c0 = fluid.layers.data("c0", shape=[4], dtype="float32")
+        h1, c1 = fluid.layers.lstm_unit(xt, h0, c0, forget_bias=1.0)
+        feed = {"xt": RNG.rand(3, 5).astype(np.float32),
+                "h0": np.zeros((3, 4), np.float32),
+                "c0": np.zeros((3, 4), np.float32)}
+        got, _ = _run(fluid.default_main_program(), feed, [h1, c1],
+                      startup=fluid.default_startup_program())
+    assert got[0].shape == (3, 4) and got[1].shape == (3, 4)
+    assert np.isfinite(got[0]).all()
+
+
+def test_generic_wrapper_spot_checks():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xv = fluid.layers.data("x", shape=[4, 8, 8], dtype="float32")
+        lbl = fluid.layers.data("y", shape=[1], dtype="int64")
+        outs = {
+            "maxout": fluid.layers.maxout(xv, groups=2),
+            "s2d": fluid.layers.space_to_depth(xv, 2),
+            "pix": fluid.layers.pixel_shuffle(xv, 2),
+            "smooth": fluid.layers.label_smooth(
+                fluid.layers.one_hot(lbl, 10), epsilon=0.1),
+            "pool": fluid.layers.adaptive_pool2d(xv, [2, 2], "avg"),
+            "sign": fluid.layers.sign(xv),
+            "mse": fluid.layers.mse_loss(
+                fluid.layers.flatten(xv),
+                fluid.layers.flatten(xv)),
+        }
+        feed = {"x": RNG.randn(2, 4, 8, 8).astype(np.float32),
+                "y": np.array([[3], [7]], np.int64)}
+        names = list(outs)
+        got, _ = _run(fluid.default_main_program(), feed,
+                      [outs[n] for n in names])
+    res = dict(zip(names, got))
+    assert res["maxout"].shape == (2, 2, 8, 8)
+    assert res["s2d"].shape == (2, 16, 4, 4)
+    assert res["pix"].shape == (2, 1, 16, 16)
+    np.testing.assert_allclose(res["smooth"].sum(-1), 1.0, rtol=1e-5)
+    assert res["pool"].shape == (2, 4, 2, 2)
+    assert set(np.unique(res["sign"])) <= {-1.0, 0.0, 1.0}
+    assert res["mse"] == 0

@@ -343,9 +343,12 @@ def test_chunk_eval_iob():
 def test_crf_layer_end_to_end_training():
     """linear_chain_crf + crf_decoding as layers: loss decreases and decode
     recovers a learnable pattern (the label IS argmax-able from emission)."""
+    import paddle_tpu.unique_name as un
+
     b, t, d = 8, 6, 4
     rng = np.random.RandomState(3)
-    with fluid.program_guard(fluid.Program(), fluid.Program()):
+    with un.guard(), fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_main_program().random_seed = 11
         feat = fluid.layers.data(name="feat", shape=[t, d], dtype="float32",
                                  lod_level=0)
         lbl = fluid.layers.data(name="lbl", shape=[t], dtype="int64")
