@@ -32,37 +32,50 @@ def seq_len_var(x: Variable) -> Variable:
     chain is walked until a var with a companion is found (the reference
     propagates LoD in each op's InferShape; here it is derived on demand).
 
-    Caveat: the walk is input-order dependent — an op mixing tensors from
-    DIFFERENT sequences binds the first companion found. When lengths are
-    ambiguous, pass the intended sequence explicitly by attaching its
-    companion (produce the tensor with a sequence op, or declare the input
-    with lod_level=1) rather than relying on inference."""
+    When the producer graph reaches MORE THAN ONE distinct companion (an
+    op mixing tensors from different sequences), the first in input order
+    is used and a RuntimeWarning names all candidates — pass the intended
+    sequence explicitly (produce the tensor with a sequence op, or declare
+    the input with lod_level=1) to silence it."""
     block = x.block
-    name = _infer_lod_name(block, x.name, set())
-    if name is None:
+    # one exhaustive walk serves both purposes: found[0] is exactly what
+    # the old short-circuiting walk returned (same DFS order), the rest
+    # detects ambiguity
+    all_names: list = []
+    _collect_lod_names(block, x.name, set(), all_names)
+    if not all_names:
         raise ValueError(
             f"'{x.name}' has no sequence lengths companion "
             f"'{x.name}{lod_suffix}' and none could be inferred from its "
             f"producers — declare the input with layers.data(..., "
             f"lod_level=1) or produce '{x.name}' with a sequence op")
+    name = all_names[0]
+    if len(set(all_names)) > 1:
+        import warnings
+
+        warnings.warn(
+            f"seq_len_var('{x.name}'): multiple sequence-length companions"
+            f" are reachable through its producers ({sorted(set(all_names))}"
+            f"); using '{name}'. If that is the wrong sequence, pass "
+            f"lengths explicitly.", RuntimeWarning, stacklevel=3)
     return block._var_recursive(name)
 
 
-def _infer_lod_name(block, name, seen):
+def _collect_lod_names(block, name, seen, found):
+    """Producer-graph walk gathering EVERY reachable companion (DFS,
+    input order): found[0] is the binding, the rest flag ambiguity."""
     if block.has_var_recursive(name + lod_suffix):
-        return name + lod_suffix
+        found.append(name + lod_suffix)
+        return
     if name in seen:
-        return None
+        return
     seen.add(name)
     for op in reversed(block.ops):
         if name in op.output_arg_names:
             for n in op.input_arg_names:
                 if n != name and n != "@EMPTY@":
-                    r = _infer_lod_name(block, n, seen)
-                    if r is not None:
-                        return r
-            return None
-    return None
+                    _collect_lod_names(block, n, seen, found)
+            return
 
 
 def _make_lod_out(helper: LayerHelper, out: Variable) -> Variable:

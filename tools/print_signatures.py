@@ -1,0 +1,74 @@
+"""API-freeze signature dump (reference tools/print_signatures.py).
+
+Usage:
+    python tools/print_signatures.py paddle_tpu > tools/api_signatures.txt
+
+Walks the public API surface (modules re-exported from the root package,
+plus fluid.layers / optimizer / dygraph / contrib namespaces) and prints
+one stable line per callable: qualified name + argspec. The committed
+tools/api_signatures.txt is the freeze; tests/test_api_freeze.py fails
+when a signature changes without regenerating the file — the reference's
+CI gate against accidental API breaks (tools/check_api_compatible.py).
+"""
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import sys
+
+# python puts the SCRIPT's dir on sys.path; the package lives one up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SKIP_PREFIXES = ("_",)
+
+
+def signature_of(member):
+    try:
+        if inspect.isclass(member):
+            try:
+                sig = str(inspect.signature(member.__init__))
+            except (ValueError, TypeError):
+                sig = "(...)"
+            return f"class{sig}"
+        sig = str(inspect.signature(member))
+        return sig
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def walk(module_name):
+    mod = importlib.import_module(module_name)
+    lines = {}
+
+    def visit(mod, prefix, depth):
+        if depth > 3:
+            return
+        for name in dir(mod):
+            if name.startswith(SKIP_PREFIXES):
+                continue
+            try:
+                member = getattr(mod, name)
+            except Exception:
+                continue
+            qual = f"{prefix}.{name}"
+            if inspect.ismodule(member):
+                # only descend into our own package
+                if getattr(member, "__name__", "").startswith(module_name) \
+                        and "." not in name:
+                    visit(member, qual, depth + 1)
+            elif callable(member):
+                lines[qual] = signature_of(member)
+    visit(mod, module_name, 0)
+    return lines
+
+
+def main():
+    module_name = sys.argv[1] if len(sys.argv) > 1 else "paddle_tpu"
+    lines = walk(module_name)
+    for name in sorted(lines):
+        print(f"{name} {lines[name]}")
+
+
+if __name__ == "__main__":
+    main()
