@@ -9,8 +9,10 @@ shapes/dtypes/value-ranges of the real dataset — enough to drive every
 pipeline, model and test. The reader contract is the reference one: a
 loader returns a zero-arg creator whose iterator yields sample tuples.
 """
-from . import (cifar, conll05, imdb, imikolov, mnist,  # noqa: F401
-               movielens, uci_housing, wmt16)
+from . import (cifar, conll05, flowers, imdb, imikolov,  # noqa: F401
+               mnist, movielens, sentiment, uci_housing, voc2012,
+               wmt14, wmt16)
 
 __all__ = ["mnist", "cifar", "imdb", "imikolov", "uci_housing",
-           "movielens", "conll05", "wmt16"]
+           "movielens", "conll05", "wmt16", "wmt14", "flowers",
+           "sentiment", "voc2012"]

@@ -72,6 +72,10 @@ class DataLoader:
         self._return_list = return_list
         self._places = None
         self._batch_reader: Optional[Callable] = None
+        # deterministic data-order resume (state_dict/set_state_dict)
+        self._batches_served = 0
+        self._epochs_done = 0
+        self._skip_batches = 0
         # non-iterable (start/reset/next) mode: the live epoch iterator
         self._iter = None
 
@@ -140,6 +144,21 @@ class DataLoader:
                 else jax.device_put(arr)
         return out
 
+    # -- deterministic resume (SURVEY §5 failure/elastic) -----------------
+    def state_dict(self) -> dict:
+        """Data-order resume point: how many batches this epoch has served
+        (plus completed epochs). Restoring via ``set_state_dict`` makes the
+        NEXT epoch skip exactly that many batches, so training continues on
+        the sample the crash interrupted — provided the underlying reader
+        is deterministic (same files, same order, same shuffle seed; the
+        reference gets the same guarantee from Dataset checkpointing its
+        file cursor, data_set.h)."""
+        return {"epoch": self._epochs_done, "batch": self._batches_served}
+
+    def set_state_dict(self, state: dict):
+        self._skip_batches = int(state.get("batch", 0))
+        self._epochs_done = int(state.get("epoch", 0))
+
     # -- iteration ---------------------------------------------------------
     def __iter__(self):
         if self._batch_reader is None:
@@ -148,12 +167,17 @@ class DataLoader:
                                "set_batch_generator first")
         q: queue.Queue = queue.Queue(maxsize=self._capacity)
         stop = threading.Event()
+        skip = self._skip_batches
+        self._skip_batches = 0
+        self._batches_served = skip
 
         def worker():
             try:
-                for batch in self._batch_reader():
+                for i, batch in enumerate(self._batch_reader()):
                     if stop.is_set():
                         return
+                    if i < skip:
+                        continue  # fast-forward: resume mid-epoch
                     q.put(self._stage(batch))
                 q.put(_EOE)
             except BaseException as e:  # surface in the consumer
@@ -166,9 +190,12 @@ class DataLoader:
             while True:
                 item = q.get()
                 if item is _EOE:
+                    self._epochs_done += 1
+                    self._batches_served = 0
                     return
                 if isinstance(item, BaseException):
                     raise item
+                self._batches_served += 1
                 if self._return_list:
                     yield [item[n] for n in self._feed_names]
                 else:

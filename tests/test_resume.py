@@ -1,0 +1,119 @@
+"""Deterministic data-order resume (SURVEY §5 failure/elastic — the gap
+every verdict listed): DataLoader.state_dict()/set_state_dict() +
+io.save/load_checkpoint restart training on the exact sample the crash
+interrupted, and the resumed loss trajectory matches the uninterrupted
+run bit-for-bit."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.unique_name as un
+
+
+def _samples():
+    rng = np.random.RandomState(0)
+    w = rng.rand(4, 1).astype(np.float32)
+    xs = rng.rand(64, 4).astype(np.float32)
+    return [(x, x @ w) for x in xs]
+
+
+def _build():
+    x = fluid.layers.data("x", shape=[4], dtype="float32")
+    y = fluid.layers.data("y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return x, y, loss
+
+
+def _loader(x, y):
+    loader = fluid.DataLoader.from_generator(feed_list=[x, y], capacity=2)
+    loader.set_sample_generator(lambda: iter(_samples()), batch_size=8,
+                                drop_last=True)
+    return loader
+
+
+def test_dataloader_state_dict_resumes_mid_epoch():
+    with un.guard(), fluid.program_guard(fluid.Program(), fluid.Program()):
+        x, y, loss = _build()
+        main, startup = (fluid.default_main_program(),
+                         fluid.default_startup_program())
+        main.random_seed = 5
+
+        # uninterrupted run: 2 epochs of 8 batches
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        full = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            loader = _loader(x, y)
+            for _ in range(2):
+                for batch in loader:
+                    (lv,) = exe.run(main, feed=batch, fetch_list=[loss])
+                    full.append(float(np.asarray(lv).reshape(-1)[0]))
+
+        # interrupted run: crash after 5 batches, checkpoint, resume
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        s2 = fluid.Scope()
+        part = []
+        with fluid.scope_guard(s2):
+            exe2.run(startup)
+            loader2 = _loader(x, y)
+            served = 0
+            for batch in loader2:
+                (lv,) = exe2.run(main, feed=batch, fetch_list=[loss])
+                part.append(float(np.asarray(lv).reshape(-1)[0]))
+                served += 1
+                if served == 5:
+                    break  # "crash"
+            ck = loader2.state_dict()
+            assert ck == {"epoch": 0, "batch": 5}
+            params = {n: np.asarray(s2.find_var(n)).copy()
+                      for n in list(s2.vars)}
+
+        # fresh process: restore params + loader position, continue
+        exe3 = fluid.Executor(fluid.CPUPlace())
+        s3 = fluid.Scope()
+        with fluid.scope_guard(s3):
+            exe3.run(startup)
+            for n, v in params.items():
+                s3.set_var(n, v)
+            loader3 = _loader(x, y)
+            loader3.set_state_dict(ck)
+            for batch in loader3:     # finishes epoch 0 from batch 5
+                (lv,) = exe3.run(main, feed=batch, fetch_list=[loss])
+                part.append(float(np.asarray(lv).reshape(-1)[0]))
+            for batch in loader3:     # epoch 1
+                (lv,) = exe3.run(main, feed=batch, fetch_list=[loss])
+                part.append(float(np.asarray(lv).reshape(-1)[0]))
+    np.testing.assert_allclose(part, full, rtol=1e-6, atol=1e-7)
+
+
+def test_checkpoint_roundtrip_with_loader_state(tmp_path):
+    with un.guard(), fluid.program_guard(fluid.Program(), fluid.Program()):
+        x, y, loss = _build()
+        main, startup = (fluid.default_main_program(),
+                         fluid.default_startup_program())
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            loader = _loader(x, y)
+            it = iter(loader)
+            for _ in range(3):
+                batch = next(it)
+                exe.run(main, feed=batch, fetch_list=[loss])
+            fluid.io.save_checkpoint(
+                exe, str(tmp_path), main_program=main, scope=scope,
+                meta={"reader": loader.state_dict(), "step": 3})
+        s2 = fluid.Scope()
+        with fluid.scope_guard(s2):
+            exe.run(startup)
+            meta = fluid.io.load_checkpoint(exe, str(tmp_path),
+                                            main_program=main, scope=s2)
+            assert meta["step"] == 3
+            assert meta["reader"]["batch"] == 3
+            loader2 = _loader(x, y)
+            loader2.set_state_dict(meta["reader"])
+            remaining = sum(1 for _ in loader2)
+        assert remaining == 5  # 8 per epoch - 3 consumed
