@@ -16,7 +16,14 @@ import paddle_tpu
 print('ops registered:', len(paddle_tpu.op_registry.all_ops()))
 print('version:', paddle_tpu.__version__)"
 
-echo "== unit tests (CPU, 8 virtual devices)"
+echo "== static program linter (built-in model suite; error findings gate)"
+JAX_PLATFORMS=cpu python tools/lint_program.py --builtin
+
+echo "== op-registry conformance audit (ops without a lower rule gate)"
+JAX_PLATFORMS=cpu python tools/audit_registry.py --strict > /dev/null
+JAX_PLATFORMS=cpu python tools/audit_registry.py --untested | tail -3
+
+echo "== unit tests (CPU, 8 virtual devices; FLAGS_check_program on via conftest)"
 python -m pytest tests/ -q -x
 
 if [ "$MODE" = "full" ]; then

@@ -36,6 +36,7 @@ from .transpiler import (DistributeTranspiler, DistributeTranspilerConfig,
                          memory_optimize, release_memory)
 from . import profiler
 from . import regularizer
+from . import analysis
 from .core import registry as op_registry
 from .flags import get_flags, set_flags
 from .layers import learning_rate_scheduler  # registers fluid.layers.* decays

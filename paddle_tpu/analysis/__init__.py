@@ -1,0 +1,21 @@
+"""paddle_tpu.analysis — static program verification and registry auditing.
+
+Public surface:
+
+* ``verify_program(program, fetch_names=())`` — run the multi-pass verifier,
+  return a list of ``Diagnostic``.
+* ``check_program(...)`` — same, but raise ``ProgramVerificationError`` when
+  error-severity findings exist (the FLAGS_check_program executor hook).
+* ``audit_registry()`` / ``format_audit`` — per-op capability coverage.
+* ``CODES`` — the diagnostic-code table (see docs/ANALYSIS.md).
+"""
+from .diagnostics import (CODES, Diagnostic, ProgramVerificationError,
+                          Severity, format_diagnostics)
+from .registry_audit import audit_registry, coverage_summary, format_audit
+from .verifier import DEFAULT_PASSES, check_program, verify_program
+
+__all__ = [
+    "CODES", "Diagnostic", "ProgramVerificationError", "Severity",
+    "format_diagnostics", "audit_registry", "coverage_summary",
+    "format_audit", "DEFAULT_PASSES", "check_program", "verify_program",
+]

@@ -1,0 +1,113 @@
+"""Structured diagnostics for the program verifier.
+
+The reference stack surfaces malformed ProgramDescs as C++ enforce failures
+at op-construction time (op_registry.h schema checks, OpProto required-slot
+enforcement); this rebuild constructs graphs in pure Python, so the same bug
+class used to surface deep inside a JAX trace. ``paddle_tpu.analysis`` turns
+them back into build-site diagnostics: every finding is a ``Diagnostic`` with
+a stable code (documented in docs/ANALYSIS.md), a severity, the op's position
+and the user call site recorded by the ``op_callstack`` attr.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+__all__ = ["Diagnostic", "Severity", "CODES", "ProgramVerificationError",
+           "format_diagnostics"]
+
+
+class Severity:
+    ERROR = "error"      # the program cannot lower / computes garbage
+    WARNING = "warning"  # suspicious; lowers, but likely not what was meant
+    INFO = "info"        # observation (dead code etc.); never gates
+
+
+# code -> (severity, one-line meaning). The single source of truth used by
+# the verifier, the tests and docs/ANALYSIS.md.
+CODES = {
+    # -- pass 1: schema conformance ------------------------------------
+    "PT100": (Severity.ERROR,
+              "op type is not in the registry (and is not an auto-grad op)"),
+    "PT101": (Severity.ERROR, "required input slot absent or empty"),
+    "PT102": (Severity.ERROR, "input slot not declared by the op's schema"),
+    "PT103": (Severity.ERROR, "required output slot absent or empty"),
+    "PT104": (Severity.ERROR, "output slot not declared by the op's schema"),
+    "PT105": (Severity.ERROR, "required attr missing"),
+    "PT106": (Severity.WARNING, "attr not declared by the op's schema"),
+    "PT107": (Severity.ERROR, "non-duplicable slot holds more than one var"),
+    # -- pass 2: dataflow ----------------------------------------------
+    "PT200": (Severity.ERROR,
+              "var is read before the op that produces it (use-before-def)"),
+    "PT201": (Severity.WARNING,
+              "var is read but never produced, fed or scope-initialized"),
+    "PT202": (Severity.WARNING,
+              "write-after-write: earlier value is dead (never read)"),
+    "PT203": (Severity.INFO,
+              "op output is never read, not fetched and not persistable"),
+    # -- pass 3: lowerability ------------------------------------------
+    "PT300": (Severity.ERROR, "op's OpDef has no lower rule"),
+    "PT301": (Severity.WARNING,
+              "grad op whose forward op declares grad=None"),
+    "PT302": (Severity.WARNING,
+              "needs_rng op under FLAGS_cudnn_deterministic"),
+    # -- pass 4: shape/dtype replay ------------------------------------
+    "PT400": (Severity.WARNING,
+              "replayed infer_shape disagrees with recorded var shape"),
+    "PT401": (Severity.WARNING,
+              "replayed infer_shape disagrees with recorded var dtype"),
+}
+
+
+@dataclasses.dataclass
+class Diagnostic:
+    code: str
+    message: str
+    block_idx: int = 0
+    op_idx: Optional[int] = None
+    op_type: Optional[str] = None
+    site: str = ""  # user call site from the op's op_callstack attr
+
+    @property
+    def severity(self) -> str:
+        return CODES[self.code][0]
+
+    def __str__(self) -> str:
+        loc = f"block {self.block_idx}"
+        if self.op_idx is not None:
+            loc += f" op {self.op_idx}"
+        if self.op_type:
+            loc += f" ({self.op_type})"
+        s = f"{self.code} {self.severity}: {self.message} [{loc}]"
+        if self.site:
+            s += f"\n    created at {self.site}"
+        return s
+
+
+def format_diagnostics(diags: List[Diagnostic]) -> str:
+    if not diags:
+        return "no findings"
+    order = {Severity.ERROR: 0, Severity.WARNING: 1, Severity.INFO: 2}
+    by_sev = sorted(diags, key=lambda d: (order[d.severity], d.block_idx,
+                                          d.op_idx if d.op_idx is not None
+                                          else -1))
+    counts = {}
+    for d in diags:
+        counts[d.severity] = counts.get(d.severity, 0) + 1
+    head = ", ".join(f"{counts[s]} {s}(s)" for s in
+                     (Severity.ERROR, Severity.WARNING, Severity.INFO)
+                     if s in counts)
+    return head + "\n" + "\n".join(str(d) for d in by_sev)
+
+
+class ProgramVerificationError(ValueError):
+    """Raised by ``check_program`` when error-severity findings exist; carries
+    the full diagnostic list so callers can inspect programmatically."""
+
+    def __init__(self, diags: List[Diagnostic]):
+        self.diagnostics = diags
+        errors = [d for d in diags if d.severity == Severity.ERROR]
+        super().__init__(
+            f"program verification failed with {len(errors)} error(s) "
+            f"(FLAGS_check_program; see docs/ANALYSIS.md):\n"
+            + format_diagnostics(diags))

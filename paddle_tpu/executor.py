@@ -16,6 +16,7 @@ in place — the role of the reference's buffer-reuse/inplace passes
 from __future__ import annotations
 
 import contextlib
+import itertools
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -77,9 +78,14 @@ class Scope:
     (they are XLA intermediates), so only persistables and feeds live here.
     """
 
+    # monotonic identity for executor cache keys: id(scope) can alias after
+    # GC, silently handing a fresh Scope another scope's compiled step
+    _serial_counter = itertools.count()
+
     def __init__(self, parent: Optional["Scope"] = None):
         self.vars: Dict[str, Any] = {}
         self.parent = parent
+        self._serial = next(Scope._serial_counter)
 
     def var(self, name: str):
         return self.vars.get(name)
@@ -152,8 +158,9 @@ class _CompiledStep:
         self.ro_names = ro_names
         self.state_out_names = state_out_names
         self.fetch_names = fetch_names
-        # strong ref set by the cache owner: keys use id(program), so the
-        # program must stay alive for as long as its executable is cached
+        # ref set by the cache owner. Cache keys use program._serial (never
+        # recycled), so this is no longer needed to prevent id() aliasing —
+        # it is kept for debugging: step.program names the compiled source
         self.program = None
 
 
@@ -365,6 +372,25 @@ class Executor:
         self.place = place or TPUPlace()
         self._cache: Dict[tuple, _CompiledStep] = {}
         self._step_counter = 0
+        # program fingerprints already verified under FLAGS_check_program
+        self._verified: set = set()
+
+    def _verify_once(self, program: Program, fetch_names) -> None:
+        """FLAGS_check_program pre-run hook: static-verify each program
+        version once before it compiles (the build-time role of the
+        reference's op_registry.h checks). Raises ProgramVerificationError
+        with build-site diagnostics on error-severity findings."""
+        from .flags import flag
+
+        if not flag("check_program"):
+            return
+        fp = self._program_fingerprint(program)
+        if fp in self._verified:
+            return
+        from .analysis import check_program
+
+        check_program(program, fetch_names=fetch_names)
+        self._verified.add(fp)
 
     # -- public API ------------------------------------------------------
     def run(
@@ -379,6 +405,9 @@ class Executor:
         from .parallel.compiled_program import CompiledProgram
 
         if isinstance(program, CompiledProgram):
+            self._verify_once(program.program,
+                              [f.name if isinstance(f, Variable) else f
+                               for f in (fetch_list or [])])
             return program._run(self, feed, fetch_list, scope, return_numpy)
 
         # pserver-role program from the DistributeTranspiler shim: nothing
@@ -393,6 +422,7 @@ class Executor:
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in (fetch_list or [])]
 
+        self._verify_once(program, fetch_names)
         step = self._get_compiled(program, feed, fetch_names, scope,
                                   use_cache=use_program_cache)
         feed_vals = [self._to_device_array(feed[n], program, n)
@@ -464,10 +494,11 @@ class Executor:
                 "run_chained with PipelineOptimizer programs: the pipeline "
                 "step is already a scan; nest via GradientMergeOptimizer")
 
+        self._verify_once(program, fetch_names)
         feed_sig = tuple(sorted(
             (n,) + _shape_dtype_sig(v) for n, v in feed.items()))
         key = ("chained", self._program_fingerprint(program), feed_sig,
-               tuple(fetch_names), int(steps), id(scope))
+               tuple(fetch_names), int(steps), scope._serial)
         step = self._cache.get(key)
         if step is None:
             block = program.global_block
@@ -583,6 +614,7 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._verified.clear()
 
     # -- internals -------------------------------------------------------
     def _next_seed(self, program: Program) -> int:
@@ -605,8 +637,9 @@ class Executor:
         # _version counts op appends AND Operator.set_attr mutations, so
         # flipping e.g. is_test on a cached program recompiles (the reference
         # invalidates via desc version); op count catches op removal, which
-        # bumps no counter
-        return (id(program), getattr(program, "_version", 0),
+        # bumps no counter. _serial (not id()) so GC can never alias two
+        # programs onto one cache entry.
+        return (program._serial, getattr(program, "_version", 0),
                 sum(len(b.ops) for b in program.blocks))
 
     def _get_compiled(self, program, feed, fetch_names, scope,
@@ -617,7 +650,7 @@ class Executor:
         from .flags import flag
 
         key = (self._program_fingerprint(program), feed_sig,
-               tuple(fetch_names), id(scope), flag("check_nan_inf"))
+               tuple(fetch_names), scope._serial, flag("check_nan_inf"))
         if use_cache and key in self._cache:
             return self._cache[key]
         step = self._compile(program, set(feed.keys()), fetch_names, scope)

@@ -18,6 +18,12 @@ _DEFS: Dict[str, tuple] = {
     "check_nan_inf": (bool, False,
                       "per-op finite checks with op provenance on failure "
                       "(reference flags.cc:44; operator.cc fast_check_nan_inf)"),
+    "check_program": (bool, False,
+                      "static-verify programs before first execution "
+                      "(paddle_tpu.analysis.check_program; error-severity "
+                      "findings raise ProgramVerificationError with the op's "
+                      "build site — see docs/ANALYSIS.md). On by default in "
+                      "the test suite via tests/conftest.py"),
     "paddle_num_threads": (int, 1, "host threads hint (XLA owns scheduling)"),
     "seq_bucket_sizes": (str, "", "override DataFeeder varlen buckets, csv"),
     "conv_use_nhwc": (str, "auto",

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import itertools
 import json
 import os
 import sys
@@ -439,7 +440,13 @@ class Block:
 class Program:
     """A multi-block program (reference framework.py:3152, framework.proto:212)."""
 
+    # monotonic identity for executor cache keys: id(program) can alias
+    # after GC, handing a fresh Program a dead program's compiled step or
+    # verified-program cache entry
+    _serial_counter = itertools.count()
+
     def __init__(self):
+        self._serial = next(Program._serial_counter)
         self.blocks: List[Block] = [Block(self, 0)]
         self.current_block_idx = 0
         self._uid_counter = 0

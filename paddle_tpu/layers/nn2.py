@@ -686,7 +686,8 @@ def deformable_roi_pooling(input, rois, trans, no_trans=False,
                            spatial_scale=1.0, group_size=(1, 1),
                            pooled_height=1, pooled_width=1,
                            part_size=None, sample_per_part=1, trans_std=0.1,
-                           position_sensitive=True, name=None):
+                           position_sensitive=True, rois_batch_idx=None,
+                           name=None):
     helper = LayerHelper("deformable_psroi_pooling", name=name)
     if not position_sensitive:
         raise NotImplementedError(
@@ -700,8 +701,11 @@ def deformable_roi_pooling(input, rois, trans, no_trans=False,
     o = helper.create_variable_for_type_inference(input.dtype)
     cnt = helper.create_variable_for_type_inference(input.dtype,
                                                     stop_gradient=True)
+    ins = {"Input": input, "ROIs": rois, "Trans": trans}
+    if rois_batch_idx is not None:
+        ins["RoisBatchIdx"] = rois_batch_idx
     helper.append_op("deformable_psroi_pooling",
-                     inputs={"Input": input, "ROIs": rois, "Trans": trans},
+                     inputs=ins,
                      outputs={"Output": o, "TopCount": cnt},
                      attrs={"no_trans": no_trans,
                             "spatial_scale": spatial_scale,
@@ -714,8 +718,11 @@ def deformable_roi_pooling(input, rois, trans, no_trans=False,
 
 
 def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
-               pooled_width, name=None):
-    return _one("psroi_pool", {"X": input, "ROIs": rois},
+               pooled_width, rois_batch_idx=None, name=None):
+    """``rois_batch_idx``: int tensor [R] mapping each ROI to its image in
+    the batch (as roi_pool/roi_align accept); required when batch > 1."""
+    return _one("psroi_pool", {"X": input, "ROIs": rois,
+                               "RoisBatchIdx": rois_batch_idx},
                 {"output_channels": output_channels,
                  "spatial_scale": spatial_scale,
                  "pooled_height": pooled_height,
@@ -723,8 +730,21 @@ def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
 
 
 def prroi_pool(input, rois, output_channels=None, spatial_scale=1.0,
-               pooled_height=1, pooled_width=1, name=None):
-    return _one("prroi_pool", {"X": input, "ROIs": rois},
+               pooled_height=1, pooled_width=1, batch_roi_nums=None,
+               rois_batch_idx=None, name=None):
+    """``batch_roi_nums``: int tensor [B] of ROI counts per image (the
+    reference's prroi_pool signature) — counts must sum to the ROI count R,
+    or trailing ROIs are silently mis-assigned (runtime data: unverifiable
+    at trace time); ``rois_batch_idx``: int tensor [R] of per-ROI image
+    indices. One of the two is required when batch > 1."""
+    if batch_roi_nums is not None and rois_batch_idx is not None:
+        raise ValueError(
+            "prroi_pool: pass either batch_roi_nums or rois_batch_idx, "
+            "not both — with conflicting values the op would silently "
+            "follow rois_batch_idx")
+    return _one("prroi_pool", {"X": input, "ROIs": rois,
+                               "BatchRoINums": batch_roi_nums,
+                               "RoisBatchIdx": rois_batch_idx},
                 {"spatial_scale": spatial_scale,
                  "pooled_height": pooled_height,
                  "pooled_width": pooled_width}, name=name)

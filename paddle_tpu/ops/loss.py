@@ -261,8 +261,8 @@ def _bpr_loss(ctx, ins, attrs):
 
 
 @register_op("cos_sim", inputs=[IOSpec("X"), IOSpec("Y")],
-             outputs=["Out", IOSpec("XNorm", no_grad=True),
-                      IOSpec("YNorm", no_grad=True)])
+             outputs=["Out", IOSpec("XNorm", optional=True, no_grad=True),
+                      IOSpec("YNorm", optional=True, no_grad=True)])
 def _cos_sim(ctx, ins, attrs):
     xv, yv = x(ins, "X"), x(ins, "Y")
     xn = jnp.sqrt((xv * xv).sum(-1, keepdims=True))

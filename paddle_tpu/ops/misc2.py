@@ -117,10 +117,8 @@ def _psroi_pool(ctx, ins, attrs):
     o*ph*pw + i*pw + j over that bin's region."""
     inp = x(ins, "X")                # [B, oc*ph*pw, H, W]
     rois = x(ins, "ROIs")            # [R, 4]
-    bidx = x(ins, "RoisBatchIdx")
-    r = rois.shape[0]
-    bidx = (jnp.zeros((r,), jnp.int32) if bidx is None
-            else bidx.reshape(-1).astype(jnp.int32))
+    bidx = _roi_batch_indices("psroi_pool", inp, rois,
+                              x(ins, "RoisBatchIdx"), None)
     oc = int(attrs["output_channels"])
     ph, pw = int(attrs["pooled_height"]), int(attrs["pooled_width"])
     scale = float(attrs["spatial_scale"])
@@ -155,9 +153,33 @@ def _psroi_pool(ctx, ins, attrs):
     return out(jax.vmap(one)(rois, bidx))
 
 
+def _roi_batch_indices(op_type, inp, rois, bidx, nums, layer=None):
+    """Resolve each ROI's image index from RoisBatchIdx [R] or BatchRoINums
+    [B] (counts per image). With neither and batch > 1, refuse: pooling
+    every ROI from image 0 computes silently wrong results."""
+    r = rois.shape[0]
+    if bidx is not None:
+        return bidx.reshape(-1).astype(jnp.int32)
+    if nums is not None:
+        # counts are runtime data; total_repeat_length keeps the shape
+        # static. Callers must ensure sum(nums) == R — a mismatch pads or
+        # truncates the tail, which cannot be detected inside the trace
+        return jnp.repeat(jnp.arange(inp.shape[0], dtype=jnp.int32),
+                          nums.reshape(-1).astype(jnp.int32),
+                          total_repeat_length=r)
+    if inp.shape[0] > 1:
+        raise NotImplementedError(
+            f"{op_type}: X has batch size {inp.shape[0]} but neither "
+            f"RoisBatchIdx nor BatchRoINums was given — every ROI would "
+            f"pool from image 0; pass rois_batch_idx through the layer "
+            f"wrapper (fluid.layers.{layer or op_type})")
+    return jnp.zeros((r,), jnp.int32)
+
+
 @register_op("prroi_pool",
              inputs=[IOSpec("X"), IOSpec("ROIs", no_grad=True),
-                     IOSpec("BatchRoINums", optional=True, no_grad=True)],
+                     IOSpec("BatchRoINums", optional=True, no_grad=True),
+                     IOSpec("RoisBatchIdx", optional=True, no_grad=True)],
              outputs=["Out"],
              attrs={"spatial_scale": 1.0, "pooled_height": 1,
                     "pooled_width": 1, "sample_num": 4})
@@ -168,8 +190,9 @@ def _prroi_pool(ctx, ins, attrs):
     to the same value and keeps the op a fixed-shape gather program."""
     inp = x(ins, "X")
     rois = x(ins, "ROIs")
-    r = rois.shape[0]
-    bidx = jnp.zeros((r,), jnp.int32)
+    bidx = _roi_batch_indices("prroi_pool", inp, rois,
+                              x(ins, "RoisBatchIdx"),
+                              x(ins, "BatchRoINums"))
     ph, pw = int(attrs["pooled_height"]), int(attrs["pooled_width"])
     scale = float(attrs["spatial_scale"])
     s = max(int(attrs.get("sample_num", 4)), 1)
@@ -194,7 +217,8 @@ def _prroi_pool(ctx, ins, attrs):
 
 @register_op("deformable_psroi_pooling",
              inputs=[IOSpec("Input"), IOSpec("ROIs", no_grad=True),
-                     IOSpec("Trans")],
+                     IOSpec("Trans"),
+                     IOSpec("RoisBatchIdx", optional=True, no_grad=True)],
              outputs=["Output", "TopCount"],
              attrs={"no_trans": False, "spatial_scale": 1.0,
                     "output_dim": 1, "group_size": [1, 1],
@@ -209,8 +233,9 @@ def _deformable_psroi_pooling(ctx, ins, attrs):
     inp = x(ins, "Input")            # [B, od*gh*gw, H, W]
     rois = x(ins, "ROIs")            # [R, 4]
     trans = x(ins, "Trans")          # [R, 2, part_h, part_w]
-    r = rois.shape[0]
-    bidx = jnp.zeros((r,), jnp.int32)
+    bidx = _roi_batch_indices("deformable_psroi_pooling", inp, rois,
+                              x(ins, "RoisBatchIdx"), None,
+                              layer="deformable_roi_pooling")
     od = int(attrs["output_dim"])
     gh, gw = [int(v) for v in attrs["group_size"]]
     ph, pw = int(attrs["pooled_height"]), int(attrs["pooled_width"])

@@ -54,7 +54,7 @@ def _scan_outputs(step, carry, xs_tm, lengths):
                      IOSpec("Bias", optional=True),
                      IOSpec("H0", optional=True), IOSpec("C0", optional=True),
                      IOSpec("SeqLen", no_grad=True)],
-             outputs=["Hidden", "Cell"],
+             outputs=["Hidden", IOSpec("Cell", optional=True)],
              attrs={"use_peepholes": True, "is_reverse": False,
                     "gate_activation": "sigmoid",
                     "cell_activation": "tanh",

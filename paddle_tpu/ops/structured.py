@@ -52,7 +52,9 @@ def _canon_label(label):
              inputs=[IOSpec("Emission"), IOSpec("Transition"),
                      IOSpec("Label", no_grad=True),
                      IOSpec("Length", optional=True, no_grad=True)],
-             outputs=["Alpha", "EmissionExps", "TransitionExps",
+             outputs=[IOSpec("Alpha", optional=True),
+                      IOSpec("EmissionExps", optional=True),
+                      IOSpec("TransitionExps", optional=True),
                       "LogLikelihood"])
 def _linear_chain_crf(ctx, ins, attrs):
     """Per-sequence negative log-likelihood (a cost, like the reference:
@@ -252,7 +254,7 @@ def _nce(ctx, ins, attrs):
                      IOSpec("PathTable", optional=True, no_grad=True),
                      IOSpec("PathCode", optional=True, no_grad=True),
                      IOSpec("Bias", optional=True)],
-             outputs=["Out", "PreOut"],
+             outputs=["Out", IOSpec("PreOut", optional=True)],
              attrs={"num_classes": 2, "is_sparse": False,
                     "remote_prefetch": False})
 def _hierarchical_sigmoid(ctx, ins, attrs):

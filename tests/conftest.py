@@ -14,11 +14,22 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
+# static program verification before every executor run (the analysis
+# subsystem's opt-in hook, on by default for the suite; docs/ANALYSIS.md)
+os.environ.setdefault("FLAGS_check_program", "1")
+
 import jax  # noqa: E402
 
 if os.environ.get("PADDLE_TPU_TESTS") != "1":
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # older jax (<0.5) spells it via XLA_FLAGS; the env var is read at
+        # backend init, which hasn't happened yet even though sitecustomize
+        # imported jax at interpreter startup
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
 
 
 def pytest_configure(config):
@@ -26,6 +37,20 @@ def pytest_configure(config):
         "markers",
         "tpu: needs a real accelerator; run with PADDLE_TPU_TESTS=1 "
         "pytest -m tpu (skipped on the CPU suite)")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_global_clip_leak():
+    """set_gradient_clip is process-global (reference keeps it per-program);
+    a test that sets it and fails before resetting would silently reshape
+    every later test's training. Clear it after each test."""
+    yield
+    from paddle_tpu import clip
+
+    clip._clip_attr["__global__"] = None
 
 
 def pytest_collection_modifyitems(config, items):

@@ -111,6 +111,129 @@ def test_prroi_pool_uniform_image():
     np.testing.assert_allclose(got[0], 3.5, rtol=1e-4)
 
 
+def test_prroi_pool_batched_rois_batch_idx():
+    """r5 advisor finding: prroi_pool must honor per-ROI image indices —
+    with two distinct uniform images, each ROI pools its own image."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xv = fluid.layers.data("x", shape=[2, 6, 6], dtype="float32")
+        rois = fluid.layers.data("rois", shape=[4], dtype="float32",
+                                 append_batch_size=False)
+        bidx = fluid.layers.data("bidx", shape=[2], dtype="int32",
+                                 append_batch_size=False)
+        o = fluid.layers.prroi_pool(xv, rois, spatial_scale=1.0,
+                                    pooled_height=2, pooled_width=2,
+                                    rois_batch_idx=bidx)
+        xb = np.stack([np.full((2, 6, 6), 1.0, np.float32),
+                       np.full((2, 6, 6), 5.0, np.float32)])
+        feed = {"x": xb,
+                "rois": np.array([[1, 1, 4, 4], [1, 1, 4, 4]], np.float32),
+                "bidx": np.array([0, 1], np.int32)}
+        got, _ = _run(fluid.default_main_program(), feed, [o])
+    np.testing.assert_allclose(got[0][0], 1.0, rtol=1e-4)
+    np.testing.assert_allclose(got[0][1], 5.0, rtol=1e-4)
+
+
+def test_prroi_pool_batch_roi_nums():
+    """BatchRoINums [B] (the reference's signature): counts per image
+    resolve to the same per-ROI indices."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xv = fluid.layers.data("x", shape=[1, 6, 6], dtype="float32")
+        rois = fluid.layers.data("rois", shape=[4], dtype="float32",
+                                 append_batch_size=False)
+        nums = fluid.layers.data("nums", shape=[2], dtype="int32",
+                                 append_batch_size=False)
+        o = fluid.layers.prroi_pool(xv, rois, spatial_scale=1.0,
+                                    pooled_height=1, pooled_width=1,
+                                    batch_roi_nums=nums)
+        xb = np.stack([np.full((1, 6, 6), 2.0, np.float32),
+                       np.full((1, 6, 6), 7.0, np.float32)])
+        feed = {"x": xb,
+                "rois": np.array([[1, 1, 4, 4]] * 3, np.float32),
+                "nums": np.array([1, 2], np.int32)}  # img0: 1 ROI, img1: 2
+        got, _ = _run(fluid.default_main_program(), feed, [o])
+    np.testing.assert_allclose(got[0].reshape(-1), [2.0, 7.0, 7.0],
+                               rtol=1e-4)
+
+
+def test_psroi_pool_batched_rois_batch_idx():
+    """psroi_pool honors per-ROI image indices like its prroi sibling."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xv = fluid.layers.data("x", shape=[4, 6, 6], dtype="float32")
+        rois = fluid.layers.data("rois", shape=[4], dtype="float32",
+                                 append_batch_size=False)
+        bidx = fluid.layers.data("bidx", shape=[2], dtype="int32",
+                                 append_batch_size=False)
+        o = fluid.layers.psroi_pool(xv, rois, output_channels=1,
+                                    spatial_scale=1.0, pooled_height=2,
+                                    pooled_width=2, rois_batch_idx=bidx)
+        xb = np.stack([np.full((4, 6, 6), 2.0, np.float32),
+                       np.full((4, 6, 6), 8.0, np.float32)])
+        feed = {"x": xb,
+                "rois": np.array([[1, 1, 4, 4], [1, 1, 4, 4]], np.float32),
+                "bidx": np.array([0, 1], np.int32)}
+        got, _ = _run(fluid.default_main_program(), feed, [o])
+    np.testing.assert_allclose(got[0][0], 2.0, rtol=1e-4)
+    np.testing.assert_allclose(got[0][1], 8.0, rtol=1e-4)
+
+
+def test_psroi_pool_multibatch_without_index_refuses():
+    """psroi_pool with batch > 1 and no RoisBatchIdx must raise, not pool
+    every ROI from image 0 (same contract as prroi_pool)."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xv = fluid.layers.data("x", shape=[4, 6, 6], dtype="float32")
+        rois = fluid.layers.data("rois", shape=[4], dtype="float32",
+                                 append_batch_size=False)
+        o = fluid.layers.psroi_pool(xv, rois, output_channels=1,
+                                    spatial_scale=1.0, pooled_height=2,
+                                    pooled_width=2)
+        feed = {"x": np.ones((2, 4, 6, 6), np.float32),
+                "rois": np.array([[1, 1, 4, 4]], np.float32)}
+        with pytest.raises(Exception, match="psroi_pool.*batch"):
+            _run(fluid.default_main_program(), feed, [o])
+
+
+def test_prroi_pool_multibatch_without_index_refuses():
+    """Batch > 1 with no batch-index information must raise, not silently
+    pool every ROI from image 0."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xv = fluid.layers.data("x", shape=[2, 6, 6], dtype="float32")
+        rois = fluid.layers.data("rois", shape=[4], dtype="float32",
+                                 append_batch_size=False)
+        o = fluid.layers.prroi_pool(xv, rois, spatial_scale=1.0,
+                                    pooled_height=2, pooled_width=2)
+        feed = {"x": np.ones((2, 2, 6, 6), np.float32),
+                "rois": np.array([[1, 1, 4, 4]], np.float32)}
+        with pytest.raises(Exception, match="prroi_pool.*batch"):
+            _run(fluid.default_main_program(), feed, [o])
+
+
+def test_deformable_roi_pooling_batched_rois_batch_idx():
+    """deformable_psroi_pooling honors RoisBatchIdx (r5 advisor finding):
+    no_trans + uniform per-image values -> each ROI reads its image."""
+    gs = (1, 1)
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xv = fluid.layers.data("x", shape=[2, 6, 6], dtype="float32")
+        rois = fluid.layers.data("rois", shape=[4], dtype="float32",
+                                 append_batch_size=False)
+        trans = fluid.layers.data("trans", shape=[2, 2, 1, 1],
+                                  dtype="float32", append_batch_size=False)
+        bidx = fluid.layers.data("bidx", shape=[2], dtype="int32",
+                                 append_batch_size=False)
+        o = fluid.layers.deformable_roi_pooling(
+            xv, rois, trans, no_trans=True, group_size=list(gs),
+            pooled_height=1, pooled_width=1, sample_per_part=2,
+            rois_batch_idx=bidx)
+        xb = np.stack([np.full((2, 6, 6), 1.5, np.float32),
+                       np.full((2, 6, 6), 4.5, np.float32)])
+        feed = {"x": xb,
+                "rois": np.array([[1, 1, 4, 4], [1, 1, 4, 4]], np.float32),
+                "trans": np.zeros((2, 2, 1, 1), np.float32),
+                "bidx": np.array([0, 1], np.int32)}
+        got, _ = _run(fluid.default_main_program(), feed, [o])
+    np.testing.assert_allclose(got[0][0], 1.5, rtol=1e-4)
+    np.testing.assert_allclose(got[0][1], 4.5, rtol=1e-4)
+
+
 def test_sampled_softmax_with_cross_entropy_trains():
     with fluid.program_guard(fluid.Program(), fluid.Program()):
         xv = fluid.layers.data("x", shape=[8], dtype="float32")

@@ -1,0 +1,412 @@
+"""paddle_tpu.analysis: one test per diagnostic code, clean-program
+baselines, the executor FLAGS_check_program hook, and the registry audit.
+
+Malformed-graph fixtures mutate ops *after* append (direct field writes,
+bypassing Operator.set_attr) — exactly the bug class the static verifier
+exists to catch before a JAX trace turns it into an XLA-flavoured error.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.unique_name as un
+from paddle_tpu.analysis import (CODES, ProgramVerificationError, Severity,
+                                 audit_registry, check_program,
+                                 coverage_summary, format_audit,
+                                 format_diagnostics, verify_program)
+from paddle_tpu.core import registry
+
+
+def codes_of(diags):
+    return {d.code for d in diags}
+
+
+def errors_of(diags):
+    return [d for d in diags if d.severity == Severity.ERROR]
+
+
+def _mlp_program(fetch=True):
+    """Small clean net: data -> fc -> relu -> fc -> mean, with backward+SGD."""
+    main, startup = fluid.Program(), fluid.Program()
+    with un.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+# ---------------------------------------------------------------------------
+# clean programs produce no error findings
+# ---------------------------------------------------------------------------
+
+def test_clean_program_no_findings():
+    main, startup, loss = _mlp_program()
+    for prog, fetches in ((main, [loss.name]), (startup, [])):
+        diags = verify_program(prog, fetch_names=fetches)
+        assert not errors_of(diags), format_diagnostics(diags)
+
+
+def test_book_model_programs_verify_clean():
+    """The tests/test_book.py model suite (built by tools/lint_program.py's
+    --builtin mode) must verify clean — main, startup AND test clones."""
+    import tools.lint_program as lint
+
+    for name, prog, fetches in lint._builtin_programs():
+        diags = verify_program(prog, fetch_names=fetches)
+        assert not errors_of(diags), f"{name}:\n" + format_diagnostics(diags)
+
+
+def test_every_code_is_documented_and_tested():
+    # the CODES table is the single source of truth; this file must cover it
+    import io
+    import os
+
+    here = os.path.abspath(__file__)
+    with io.open(here, "r", encoding="utf-8") as f:
+        me = f.read()
+    assert len(CODES) >= 10
+    for code in CODES:
+        assert me.count(code) >= 1, f"diagnostic {code} lacks a test here"
+
+
+# ---------------------------------------------------------------------------
+# pass 1: schema conformance
+# ---------------------------------------------------------------------------
+
+def _tiny():
+    """One relu op on a declared input; returns (program, block, op)."""
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        fluid.layers.relu(x)
+    blk = p.global_block
+    op = next(o for o in blk.ops if o.type == "relu")
+    return p, blk, op
+
+
+def test_pt100_unregistered_op():
+    p, blk, op = _tiny()
+    op.type = "totally_not_an_op"
+    assert "PT100" in codes_of(verify_program(p))
+
+
+def test_pt100_grad_of_unregistered_forward():
+    p, blk, op = _tiny()
+    op.type = "totally_not_an_op_grad"
+    assert "PT100" in codes_of(verify_program(p))
+
+
+def test_pt101_missing_required_input():
+    p, blk, op = _tiny()
+    del op.inputs["X"]
+    diags = verify_program(p)
+    assert "PT101" in codes_of(diags)
+    d = next(d for d in diags if d.code == "PT101")
+    assert d.op_type == "relu" and d.severity == Severity.ERROR
+
+
+def test_pt102_unknown_input_slot():
+    p, blk, op = _tiny()
+    op.inputs["Bogus"] = list(op.inputs["X"])
+    assert "PT102" in codes_of(verify_program(p))
+
+
+def test_pt103_missing_required_output():
+    p, blk, op = _tiny()
+    del op.outputs["Out"]
+    assert "PT103" in codes_of(verify_program(p))
+
+
+def test_pt104_unknown_output_slot():
+    p, blk, op = _tiny()
+    op.outputs["Bogus"] = list(op.outputs["Out"])
+    assert "PT104" in codes_of(verify_program(p))
+
+
+def test_pt105_missing_required_attr():
+    if not registry.has_op("pt_lint_reqattr"):
+        @registry.register_op("pt_lint_reqattr", inputs=["X"],
+                              outputs=["Out"],
+                              attrs={"k": registry.AttrSpec(
+                                  "k", required=True)})
+        def _lower(ctx, ins, attrs):  # pragma: no cover - never lowered
+            return {"Out": ins["X"]}
+
+    p, blk, op = _tiny()
+    blk.append_op("pt_lint_reqattr", inputs={"X": ["x"]},
+                  outputs={"Out": ["x2"]}, attrs={"k": 1})
+    del blk.ops[-1].attrs["k"]
+    assert "PT105" in codes_of(verify_program(p))
+
+
+def test_pt106_unknown_attr_warns():
+    p, blk, op = _tiny()
+    op.attrs["mystery_knob"] = 7
+    diags = verify_program(p)
+    d = next(d for d in diags if d.code == "PT106")
+    assert d.severity == Severity.WARNING  # does not gate execution
+    check_program(p)  # no raise
+
+
+def test_pt107_nonduplicable_slot_with_list():
+    p, blk, op = _tiny()
+    op.inputs["X"] = [op.inputs["X"][0], op.inputs["X"][0]]
+    assert "PT107" in codes_of(verify_program(p))
+
+
+def test_grad_op_layout_checked():
+    # a hand-built grad op with a bogus slot is caught (PT102/PT104 via the
+    # grad-specific schema path)
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        out = fluid.layers.relu(x)
+    blk = p.global_block
+    blk.append_op("relu_grad",
+                  inputs={"X": [x.name], "NotASlot": [x.name]},
+                  outputs={"X@GRAD": [x.grad_name],
+                           "Bogus@GRAD": [x.grad_name]},
+                  attrs={"__fwd_type__": "relu"})
+    codes = codes_of(verify_program(p))
+    assert "PT102" in codes and "PT104" in codes
+
+
+# ---------------------------------------------------------------------------
+# pass 2: dataflow
+# ---------------------------------------------------------------------------
+
+def test_pt200_use_before_def():
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.relu(x)
+        fluid.layers.sigmoid(h)
+    blk = p.global_block
+    # swap the two compute ops: sigmoid now reads relu's output first
+    relu_i = next(i for i, o in enumerate(blk.ops) if o.type == "relu")
+    sig_i = next(i for i, o in enumerate(blk.ops) if o.type == "sigmoid")
+    blk.ops[relu_i], blk.ops[sig_i] = blk.ops[sig_i], blk.ops[relu_i]
+    diags = verify_program(p)
+    assert "PT200" in codes_of(diags)
+    with pytest.raises(ProgramVerificationError):
+        check_program(p)
+
+
+def test_pt201_uninitialized_read():
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        fluid.layers.relu(x)
+    blk = p.global_block
+    blk.create_var(name="nowhere", shape=[4], dtype="float32")
+    op = next(o for o in blk.ops if o.type == "relu")
+    op.inputs["X"] = ["nowhere"]
+    diags = verify_program(p)
+    assert "PT201" in codes_of(diags)
+    assert all(d.severity != Severity.ERROR for d in diags
+               if d.code == "PT201")
+
+
+def test_pt202_write_after_write():
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        blk = p.global_block
+        blk.create_var(name="t", shape=[2], dtype="float32")
+        for val in (0.0, 1.0):
+            blk.append_op("fill_constant", outputs={"Out": ["t"]},
+                          attrs={"shape": [2], "dtype": "float32",
+                                 "value": val})
+    assert "PT202" in codes_of(verify_program(p))
+
+
+def test_pt203_dangling_output_is_info():
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        out = fluid.layers.relu(x)
+    diags = verify_program(p)  # not fetched -> dangling
+    assert "PT203" in codes_of(diags)
+    # fetching it silences the finding
+    assert "PT203" not in codes_of(verify_program(p, fetch_names=[out.name]))
+
+
+# ---------------------------------------------------------------------------
+# pass 3: lowerability
+# ---------------------------------------------------------------------------
+
+def test_pt300_missing_lower_rule():
+    if not registry.has_op("pt_lint_nolower"):
+        registry._OP_REGISTRY["pt_lint_nolower"] = registry.OpDef(
+            type="pt_lint_nolower",
+            inputs=[registry.IOSpec("X")],
+            outputs=[registry.IOSpec("Out")])
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        p.global_block.append_op("pt_lint_nolower", inputs={"X": [x.name]},
+                                 outputs={"Out": ["nl_out"]})
+    assert "PT300" in codes_of(verify_program(p))
+
+
+def test_pt301_grad_of_nondifferentiable():
+    # psroi_pool registers grad=None; a hand-built psroi_pool_grad op is
+    # suspicious (the generic vjp recomputation has no defined meaning)
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4, 4, 4], dtype="float32")
+        blk = p.global_block
+        blk.append_op("psroi_pool_grad",
+                      inputs={"X": [x.name]},
+                      outputs={"X@GRAD": [x.grad_name]},
+                      attrs={"__fwd_type__": "psroi_pool"})
+    diags = verify_program(p)
+    assert "PT301" in codes_of(diags)
+
+
+def test_pt302_rng_under_deterministic_flag():
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        fluid.layers.dropout(x, dropout_prob=0.5)
+    assert "PT302" not in codes_of(verify_program(p))
+    fluid.set_flags({"FLAGS_cudnn_deterministic": True})
+    try:
+        assert "PT302" in codes_of(verify_program(p))
+    finally:
+        fluid.set_flags({"FLAGS_cudnn_deterministic": False})
+
+
+# ---------------------------------------------------------------------------
+# pass 4: shape/dtype replay
+# ---------------------------------------------------------------------------
+
+def test_pt400_shape_drift():
+    p, blk, op = _tiny()
+    out_name = op.outputs["Out"][0]
+    blk.var(out_name).shape = (7, 7, 7)  # recorded metadata now stale
+    diags = verify_program(p)
+    assert "PT400" in codes_of(diags)
+    # the replay restores the recorded (wrong) metadata: verification is
+    # read-only even when it disagrees
+    assert blk.var(out_name).shape == (7, 7, 7)
+
+
+def test_pt401_dtype_drift():
+    p, blk, op = _tiny()
+    out_name = op.outputs["Out"][0]
+    blk.var(out_name).dtype = "int64"
+    assert "PT401" in codes_of(verify_program(p))
+
+
+def test_shape_replay_catches_raw_attr_mutation():
+    """The motivating bug: op.attrs['k'] = v (bypassing set_attr) leaves
+    recorded var shapes stale; the replay pass surfaces it."""
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[6], dtype="float32")
+        out = fluid.layers.reshape(x, shape=[-1, 2, 3])
+    op = next(o for o in p.global_block.ops if o.type == "reshape2")
+    op.attrs["shape"] = [-1, 3, 2]  # raw write: no version bump, no re-infer
+    assert "PT400" in codes_of(verify_program(p))
+
+
+# ---------------------------------------------------------------------------
+# executor hook (FLAGS_check_program)
+# ---------------------------------------------------------------------------
+
+def test_executor_hook_rejects_malformed_program():
+    main, startup, loss = _mlp_program()
+    blk = main.global_block
+    op = next(o for o in blk.ops if o.type == "relu")
+    del op.inputs["X"]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    fluid.set_flags({"FLAGS_check_program": True})
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(ProgramVerificationError) as ei:
+            exe.run(main, feed={"x": np.zeros((2, 4), np.float32),
+                                "y": np.zeros((2, 1), np.float32)},
+                    fetch_list=[loss.name])
+    assert "PT101" in str(ei.value)
+
+
+def test_executor_hook_covers_compiled_program():
+    """The CompiledProgram dispatch path must verify the wrapped program
+    too — multi-device users get the same build-site diagnostics."""
+    main, startup, loss = _mlp_program()
+    op = next(o for o in main.global_block.ops if o.type == "relu")
+    del op.inputs["X"]
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    fluid.set_flags({"FLAGS_check_program": True})
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(ProgramVerificationError, match="PT101"):
+            exe.run(compiled,
+                    feed={"x": np.zeros((8, 4), np.float32),
+                          "y": np.zeros((8, 1), np.float32)},
+                    fetch_list=[loss.name])
+
+
+def test_executor_hook_verifies_once_per_version():
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feed = {"x": np.zeros((2, 4), np.float32),
+            "y": np.zeros((2, 1), np.float32)}
+    fluid.set_flags({"FLAGS_check_program": True})
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        n = len(exe._verified)
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        assert len(exe._verified) == n  # cached: no re-verify per step
+
+
+# ---------------------------------------------------------------------------
+# pass 5: registry audit
+# ---------------------------------------------------------------------------
+
+def test_registry_audit_full_coverage():
+    rows = audit_registry()
+    assert len(rows) > 200
+    summary = coverage_summary(rows)
+    # every registered op must carry a lower rule (the PT300 invariant,
+    # CI-gated via tools/audit_registry.py --strict)
+    real = [r for r in rows if not r["op"].startswith("pt_lint_")]
+    assert all(r["lower"] for r in real)
+    assert summary["differentiable"] > 100
+    table = format_audit(rows)
+    assert "relu" in table and "infer_shape" in table
+
+
+def test_registry_audit_test_references():
+    import os
+
+    rows = audit_registry(test_dir=os.path.dirname(__file__))
+    by_op = {r["op"]: r for r in rows}
+    assert by_op["relu"]["tested"] is True
+    summary = coverage_summary(rows)
+    assert summary["tested"] is not None and summary["tested"] > 100
+
+
+def test_lint_cli_flags_errors(tmp_path, capsys):
+    import tools.lint_program as lint
+
+    main, startup, loss = _mlp_program()
+    op = next(o for o in main.global_block.ops if o.type == "relu")
+    del op.inputs["X"]  # survives serde (the ctor only checks op types)
+    bad = tmp_path / "bad.json"
+    bad.write_text(main.to_json())
+    good = tmp_path / "good.json"
+    good.write_text(startup.to_json())
+    assert lint.main([str(good)]) == 0
+    assert lint.main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "PT101" in out

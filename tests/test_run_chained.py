@@ -82,3 +82,18 @@ def test_chained_inference_no_state():
         for i in range(3):
             np.testing.assert_allclose(np.asarray(stacked)[i],
                                        np.asarray(one), rtol=1e-6)
+
+
+def test_scope_serial_keys_cache_not_id():
+    """r5 advisor finding: the compile cache keyed on id(scope), which can
+    alias after GC hands a dead scope's address to a fresh Scope. Scopes now
+    carry a monotonic serial used in every executor cache key."""
+    a, b = fluid.Scope(), fluid.Scope()
+    assert a._serial != b._serial
+    seen = {a._serial, b._serial}
+    del a, b
+    import gc
+
+    gc.collect()
+    c = fluid.Scope()
+    assert c._serial not in seen  # serials never recycle, unlike id()

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Op-registry conformance/coverage audit CLI.
+
+Dumps one row per registered op: infer_shape source (explicit/auto), lower
+rule presence, grad story (auto-vjp / custom / none), rng & raw flags, and
+whether any test file references the op. Makes registry gaps visible instead
+of latent.
+
+  python tools/audit_registry.py              # table to stdout
+  python tools/audit_registry.py --json       # machine-readable
+  python tools/audit_registry.py --strict     # exit 1 if any op lacks a
+                                              # lower rule (CI gate)
+  python tools/audit_registry.py --untested   # only ops no test mentions
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import paddle_tpu  # noqa: F401,E402  (registers all ops)
+from paddle_tpu.analysis import (audit_registry, coverage_summary,  # noqa: E402
+                                 format_audit)
+
+TESTS_DIR = os.path.join(os.path.dirname(__file__), "..", "tests")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when an op has no lower rule")
+    ap.add_argument("--untested", action="store_true",
+                    help="only show ops never referenced by a test file")
+    ap.add_argument("--no-tests", action="store_true",
+                    help="skip the test-reference scan")
+    args = ap.parse_args(argv)
+
+    test_dir = None if args.no_tests else os.path.abspath(TESTS_DIR)
+    rows = audit_registry(test_dir=test_dir)
+    if args.untested:
+        rows = [r for r in rows if r["tested"] is False]
+    if args.as_json:
+        print(json.dumps({"ops": rows, "summary": coverage_summary(rows)},
+                         indent=2))
+    else:
+        print(format_audit(rows))
+
+    missing_lower = [r["op"] for r in rows if not r["lower"]]
+    if missing_lower:
+        print(f"\nops without a lower rule: {missing_lower}",
+              file=sys.stderr)
+        if args.strict:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

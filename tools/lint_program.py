@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Static program linter CLI (CI face of paddle_tpu.analysis).
+
+Usage:
+  python tools/lint_program.py prog.json [prog2.json ...]
+      Lint serialized programs (Program.to_json / save_inference_model's
+      __model__ file).
+  python tools/lint_program.py --builtin
+      Build the built-in model suite (the tests/test_book.py programs:
+      fit-a-line, recognize-digits MLP, word2vec) with backward + optimizer
+      and lint main+startup of each — the CI gate that keeps the layer
+      stack, backward pass and registry schemas conformant.
+
+Exit status: 1 when any error-severity diagnostic is found (warnings and
+infos are printed but do not gate). See docs/ANALYSIS.md for the code table.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.analysis import Severity, format_diagnostics, verify_program  # noqa: E402
+
+
+def _builtin_programs():
+    """(name, program, fetch_names) triples mirroring tests/test_book.py."""
+    import paddle_tpu.unique_name as un
+
+    out = []
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[13], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.02).minimize(loss)
+        out.append(("fit_a_line/main", main, [loss.name]))
+        out.append(("fit_a_line/startup", startup, []))
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", shape=[784], dtype="float32")
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            h = fluid.layers.fc(img, 64, act="relu")
+            logits = fluid.layers.fc(h, 10)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            acc = fluid.layers.accuracy(logits, label)
+            test_prog = main.clone(for_test=True)
+            fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+        out.append(("recognize_digits/main", main, [loss.name, acc.name]))
+        out.append(("recognize_digits/startup", startup, []))
+        out.append(("recognize_digits/test_clone", test_prog,
+                    [acc.name, logits.name]))
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            w1 = fluid.layers.data("w1", shape=[1], dtype="int64")
+            w2 = fluid.layers.data("w2", shape=[1], dtype="int64")
+            nxt = fluid.layers.data("next", shape=[1], dtype="int64")
+            embs = [fluid.layers.embedding(
+                w, size=[1000, 32],
+                param_attr=fluid.ParamAttr(name="shared_emb"))
+                for w in (w1, w2)]
+            concat = fluid.layers.concat(embs, axis=1)
+            hidden = fluid.layers.fc(concat, 64, act="sigmoid")
+            logits = fluid.layers.fc(hidden, 1000)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, nxt))
+            fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+        out.append(("word2vec/main", main, [loss.name]))
+        out.append(("word2vec/startup", startup, []))
+    return out
+
+
+def _lint(name, program, fetch_names, show_info: bool) -> bool:
+    diags = verify_program(program, fetch_names=fetch_names)
+    shown = [d for d in diags
+             if show_info or d.severity != Severity.INFO]
+    errors = [d for d in diags if d.severity == Severity.ERROR]
+    n_ops = sum(len(b.ops) for b in program.blocks)
+    status = "FAIL" if errors else "ok"
+    print(f"[{status}] {name}: {n_ops} ops, "
+          f"{len(errors)} error(s), "
+          f"{sum(d.severity == Severity.WARNING for d in diags)} warning(s),"
+          f" {sum(d.severity == Severity.INFO for d in diags)} info(s)")
+    if shown:
+        print(format_diagnostics(shown))
+    return not errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("programs", nargs="*",
+                    help="serialized Program JSON files")
+    ap.add_argument("--builtin", action="store_true",
+                    help="lint the built-in model suite instead of files")
+    ap.add_argument("--show-info", action="store_true",
+                    help="also print info-severity findings (dead outputs)")
+    args = ap.parse_args(argv)
+    if not args.builtin and not args.programs:
+        ap.error("pass program JSON files or --builtin")
+
+    ok = True
+    if args.builtin:
+        for name, prog, fetches in _builtin_programs():
+            ok = _lint(name, prog, fetches, args.show_info) and ok
+    for path in args.programs:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                prog = fluid.Program.from_json(f.read())
+        except Exception as e:  # malformed beyond parsing: still a lint fail
+            print(f"[FAIL] {path}: cannot load program: "
+                  f"{type(e).__name__}: {e}")
+            ok = False
+            continue
+        ok = _lint(path, prog, [], args.show_info) and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,10 @@ def walk(module_name):
                 member = getattr(mod, name)
             except Exception:
                 continue
+            # typing re-exports (Any, Optional, ...) repr differently
+            # across interpreter versions; they are not API surface
+            if getattr(member, "__module__", "") == "typing":
+                continue
             qual = f"{prefix}.{name}"
             if inspect.ismodule(member):
                 # only descend into our own package
