@@ -23,6 +23,10 @@ echo "== op-registry conformance audit (ops without a lower rule gate)"
 JAX_PLATFORMS=cpu python tools/audit_registry.py --strict > /dev/null
 JAX_PLATFORMS=cpu python tools/audit_registry.py --untested | tail -3
 
+echo "== peak-memory plan + PT5xx liveness gate (JSON report is the CI artifact)"
+JAX_PLATFORMS=cpu python tools/mem_report.py --check \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_mem_report.json"
+
 echo "== unit tests (CPU, 8 virtual devices; FLAGS_check_program on via conftest)"
 python -m pytest tests/ -q -x
 

@@ -7,15 +7,23 @@ Public surface:
 * ``check_program(...)`` — same, but raise ``ProgramVerificationError`` when
   error-severity findings exist (the FLAGS_check_program executor hook).
 * ``audit_registry()`` / ``format_audit`` — per-op capability coverage.
+* ``liveness`` — dataflow liveness & effect analysis: proven-safe buffer
+  donation (``safe_donation_set``), peak-memory planning (``memory_plan``,
+  surfaced as ``Program.memory_plan()``), PT5xx diagnostics.
 * ``CODES`` — the diagnostic-code table (see docs/ANALYSIS.md).
 """
 from .diagnostics import (CODES, Diagnostic, ProgramVerificationError,
                           Severity, format_diagnostics)
 from .registry_audit import audit_registry, coverage_summary, format_audit
 from .verifier import DEFAULT_PASSES, check_program, verify_program
+from . import liveness
+from .liveness import (MemoryPlan, block_liveness, classify_op_effects,
+                       donation_report, memory_plan, safe_donation_set)
 
 __all__ = [
     "CODES", "Diagnostic", "ProgramVerificationError", "Severity",
     "format_diagnostics", "audit_registry", "coverage_summary",
     "format_audit", "DEFAULT_PASSES", "check_program", "verify_program",
+    "liveness", "MemoryPlan", "block_liveness", "classify_op_effects",
+    "donation_report", "memory_plan", "safe_donation_set",
 ]

@@ -56,6 +56,20 @@ CODES = {
               "replayed infer_shape disagrees with recorded var shape"),
     "PT401": (Severity.WARNING,
               "replayed infer_shape disagrees with recorded var dtype"),
+    # -- pass 5: liveness & effects ------------------------------------
+    "PT500": (Severity.WARNING,
+              "donation-unsafe fetch: var is updated in place AND fetched; "
+              "its buffer is excluded from donation"),
+    "PT501": (Severity.WARNING,
+              "write-after-fetch: var is rewritten after an explicit fetch "
+              "op (compiled steps fetch final values)"),
+    "PT502": (Severity.INFO,
+              "dead op: no output is read, fetched or persistable"),
+    "PT503": (Severity.INFO,
+              "dead var: declared but never read or written by any op"),
+    "PT504": (Severity.ERROR,
+              "persistable var written inside a sub-block never escapes to "
+              "the scope (state threading only scans the global block)"),
 }
 
 

@@ -16,6 +16,10 @@ Passes over a ``Program``:
                       and flags drift against the recorded var metadata
                       (PT40x). Catches post-append mutations that skipped
                       ``Operator.set_attr``.
+5. **liveness**     — dataflow liveness + effect classification (see
+                      ``analysis/liveness.py``): donation-unsafe fetches,
+                      write-after-fetch hazards, dead ops/vars, persistables
+                      rebound inside sub-blocks (PT50x).
 
 Only error-severity findings gate execution (see ``check_program``); warnings
 and infos are surfaced by ``tools/lint_program.py`` and the test suite.
@@ -30,7 +34,8 @@ from .diagnostics import (Diagnostic, ProgramVerificationError, Severity,
 
 __all__ = ["verify_program", "check_program", "DEFAULT_PASSES"]
 
-DEFAULT_PASSES = ("schema", "dataflow", "lowerability", "shape_replay")
+DEFAULT_PASSES = ("schema", "dataflow", "lowerability", "shape_replay",
+                  "liveness")
 
 EMPTY = "@EMPTY@"  # lowering.EMPTY_VAR_NAME (no import: keep analysis light)
 
@@ -390,11 +395,20 @@ def _check_shape_replay(program, diags: List[Diagnostic]) -> None:
 # public API
 # ---------------------------------------------------------------------------
 
+def _check_liveness_pass(program, diags: List[Diagnostic],
+                         fetch_names: Sequence[str]) -> None:
+    # lazy import: liveness.py imports helpers from this module
+    from .liveness import check_liveness
+
+    check_liveness(program, diags, fetch_names)
+
+
 _PASS_FNS = {
     "schema": lambda p, d, f: _check_schema(p, d),
     "dataflow": _check_dataflow,
     "lowerability": lambda p, d, f: _check_lowerability(p, d),
     "shape_replay": lambda p, d, f: _check_shape_replay(p, d),
+    "liveness": _check_liveness_pass,
 }
 
 

@@ -76,6 +76,26 @@ def np_dtype(dtype) -> np.dtype:
     return np.dtype(name)
 
 
+# what a 64-bit dtype request degrades to when jax runs with x64 disabled
+_X64_FALLBACK = {"int64": "int32", "uint64": "uint32", "float64": "float32"}
+
+
+def jnp_dtype(dtype) -> np.dtype:
+    """``np_dtype`` for dtypes handed to jax constructors (jnp.full,
+    jax.random.*, jnp.arange...): with ``jax_enable_x64`` off, explicitly
+    requesting int64/float64 makes every call site emit a truncation
+    warning before silently downcasting — spamming bench output once per
+    traced op. Canonicalize here instead: request the 32-bit type jax will
+    deliver anyway. Host-side numpy arrays (feeds, serialized attrs) keep
+    full width via ``np_dtype``."""
+    dt = np_dtype(dtype)
+    import jax
+
+    if not jax.config.jax_enable_x64 and dt.name in _X64_FALLBACK:
+        return np.dtype(_X64_FALLBACK[dt.name])
+    return dt
+
+
 def is_floating(dtype) -> bool:
     return canonical_dtype(dtype) in ("float16", "float32", "float64", "bfloat16")
 

@@ -9,9 +9,12 @@ feed signature). Per step, the only Python work is a dict lookup + arg packing.
 
 State threading: persistable vars live in a ``Scope`` as jax device arrays.
 The compiled step function takes (feeds, state, rng_key) and returns
-(fetches, new_state); state buffers are donated so XLA updates parameters
-in place — the role of the reference's buffer-reuse/inplace passes
-(ir/memory_optimize_pass/) is played by donation + XLA buffer assignment.
+(fetches, new_state); state buffers PROVEN safe by the static liveness pass
+(``analysis.liveness.safe_donation_set`` — every read precedes the last
+write, var not fetched) are donated so XLA updates parameters in place —
+the role of the reference's buffer-reuse/inplace passes
+(ir/memory_optimize_pass/) is played by liveness-gated donation + XLA
+buffer assignment.
 """
 from __future__ import annotations
 
@@ -151,9 +154,11 @@ class _CompiledStep:
                  state_out_names, fetch_names):
         self.fn = fn
         self.feed_names = feed_names
-        # donated: scope vars both read and re-written (params under update);
-        # their buffers are donated so XLA updates in place. ro: read-only
-        # scope vars — never donated, the scope keeps referencing them.
+        # donated: scope vars both read and re-written whose old buffer is
+        # PROVEN dead after the step (analysis.liveness.safe_donation_set);
+        # donated so XLA updates in place. ro: every other scope input —
+        # read-only vars and donation-unsafe state (e.g. a fetched param);
+        # never donated, updates still flow back via state_out.
         self.donated_names = donated_names
         self.ro_names = ro_names
         self.state_out_names = state_out_names
@@ -168,9 +173,20 @@ def analyze_block_io(block, feed_names: set, fetch_names) -> dict:
     """Classify the vars a compiled step reads/writes.
 
     Returns feed_order, state_in (scope vars read), state_out (persistables
-    written), donated (read AND written — safe to donate), ro (read-only).
+    written), donated (read AND written AND proven safe to donate — see
+    ``analysis.liveness.safe_donation_set``), ro (everything else the step
+    reads: true read-only vars plus donation-unsafe state, whose buffers
+    are never donated; their updates still flow back through state_out).
     Shared by Executor, CompiledProgram and the sharded trainer paths.
+
+    Donation used to be the bare ``state_in ∩ state_out`` heuristic, which
+    could hand XLA a buffer the fetch list still observes (a later fetch of
+    the same array would then read a consumed buffer) and had no proof the
+    old value was dead. The liveness pass supplies that proof; decisions
+    are identical or strictly safer on every program.
     """
+    from .analysis.liveness import safe_donation_set
+
     produced: set = set()
     state_in: List[str] = []
     state_out: List[str] = []
@@ -191,8 +207,9 @@ def analyze_block_io(block, feed_names: set, fetch_names) -> dict:
     for n in fetch_names:
         if n not in produced and n not in feed_names and n not in state_in:
             state_in.append(n)
-    donated = [n for n in state_in if n in state_out]
-    ro = [n for n in state_in if n not in state_out]
+    safe = safe_donation_set(block, feed_names, fetch_names)
+    donated = [n for n in state_in if n in state_out and n in safe]
+    ro = [n for n in state_in if n not in donated]
     return {"feed_order": sorted(feed_names), "state_in": state_in,
             "state_out": state_out, "donated": donated, "ro": ro}
 
@@ -503,9 +520,20 @@ class Executor:
         if step is None:
             block = program.global_block
             io = analyze_block_io(block, set(feed.keys()), fetch_names)
-            base_step = make_step_fn(block, io, fetch_names)
+            # carried: ALL read+written state threads through the scan carry
+            # (a donation-unsafe var — e.g. a fetched param — must still
+            # chain step to step; reading it as a loop-invariant would hand
+            # every iteration the stale pre-run value). donated ⊆ carried is
+            # the subset whose INPUT buffers may be donated at the jit
+            # boundary.
+            kept = [n for n in io["ro"] if n in io["state_out"]]
+            carried = list(io["donated"]) + kept
+            carried_set = set(carried)
+            ro_names = [n for n in io["ro"] if n not in carried_set]
+            io2 = dict(io, donated=carried, ro=ro_names)
+            base_step = make_step_fn(block, io2, fetch_names)
             idx = {n: i for i, n in enumerate(io["state_out"])}
-            wo_names = [n for n in io["state_out"] if n not in io["donated"]]
+            wo_names = [n for n in io["state_out"] if n not in carried_set]
 
             # Stateless programs (inference clones) have an empty carry, so
             # XLA's loop-invariant code motion would hoist the whole body out
@@ -514,24 +542,25 @@ class Executor:
             # fetch into the first float feed: exact results (the scalar IS
             # zero at runtime), but the compiler cannot prove it, so the
             # bodies stay serialized. Training programs already chain through
-            # the donated params.
-            needs_chain = not io["donated"]
+            # the carried params.
+            needs_chain = not carried
 
-            def multi_fn(feed_vals, donated_vals, ro_vals, keys, wo_init,
-                         chain_eps):
+            def multi_fn(feed_vals, donated_vals, kept_vals, ro_vals, keys,
+                         wo_init, chain_eps):
                 float_i = next(
                     (i for i, v in enumerate(feed_vals)
                      if jnp.issubdtype(jnp.result_type(v), jnp.inexact)),
                     None) if needs_chain else None
+                carried_init = list(donated_vals) + list(kept_vals)
 
                 def body(carry, k):
-                    donated, _, s = carry
+                    cur, _, s = carry
                     fv = list(feed_vals)
                     if float_i is not None:
                         fv[float_i] = fv[float_i] + (
                             chain_eps * s).astype(fv[float_i].dtype)
-                    fetches, new_state = base_step(fv, donated, ro_vals, k)
-                    new_donated = [new_state[idx[n]] for n in io["donated"]]
+                    fetches, new_state = base_step(fv, cur, ro_vals, k)
+                    new_carried = [new_state[idx[n]] for n in carried]
                     new_wo = [new_state[idx[n]] for n in wo_names]
                     s_next = s
                     if float_i is not None:
@@ -540,17 +569,19 @@ class Executor:
                                               jnp.inexact):
                                 s_next = f.ravel()[0].astype(jnp.float32)
                                 break
-                    return (new_donated, new_wo, s_next), fetches
+                    return (new_carried, new_wo, s_next), fetches
 
-                (fin_donated, fin_wo, _), stacked = jax.lax.scan(
-                    body, (donated_vals, wo_init, jnp.float32(0)), keys)
-                return stacked, fin_donated, fin_wo
+                (fin_carried, fin_wo, _), stacked = jax.lax.scan(
+                    body, (carried_init, wo_init, jnp.float32(0)), keys)
+                return stacked, fin_carried, fin_wo
 
             jitted = jax.jit(multi_fn, donate_argnums=(1,))
             step = _CompiledStep(jitted, io["feed_order"], io["donated"],
-                                 io["ro"], io["state_out"],
+                                 ro_names, io["state_out"],
                                  tuple(fetch_names))
             step.program = program
+            step.kept_names = kept
+            step.carried_names = carried
             step.wo_names = wo_names
             step.io = io
             step.base_step = base_step
@@ -560,9 +591,10 @@ class Executor:
         feed_vals = [self._to_device_array(feed[n], program, n)
                      for n in step.feed_names]
         donated_vals = [scope.find_var(n) for n in step.donated_names]
+        kept_vals = [scope.find_var(n) for n in step.kept_names]
         ro_vals = [scope.find_var(n) for n in step.ro_names]
-        for n, v in zip(step.donated_names + step.ro_names,
-                        donated_vals + ro_vals):
+        for n, v in zip(step.carried_names + step.ro_names,
+                        donated_vals + kept_vals + ro_vals):
             if v is None:
                 raise RuntimeError(
                     f"Variable '{n}' is not initialized in scope — run the "
@@ -573,12 +605,13 @@ class Executor:
         # shape them abstractly so the scan carry can thread them
         if step.wo_shapes is None:
             out_shapes = jax.eval_shape(step.base_step, feed_vals,
-                                        donated_vals, ro_vals, keys[0])
+                                        donated_vals + kept_vals, ro_vals,
+                                        keys[0])
             wo_idx = {n: i for i, n in enumerate(step.io["state_out"])}
             step.wo_shapes = [(out_shapes[1][wo_idx[n]].shape,
                                out_shapes[1][wo_idx[n]].dtype)
                               for n in step.wo_names]
-            if not step.donated_names:
+            if not step.carried_names:
                 # stateless program: the anti-hoisting chain (see multi_fn)
                 # needs a float feed to perturb AND a float fetch to carry;
                 # without both, XLA hoists the loop-invariant body and a
@@ -601,10 +634,10 @@ class Executor:
                         RuntimeWarning, stacklevel=3)
         wo_init = [jnp.zeros(s, d) for s, d in step.wo_shapes]
         with jax.default_device(self.place.jax_device()):
-            stacked, fin_donated, fin_wo = step.fn(
-                feed_vals, donated_vals, ro_vals, keys, wo_init,
+            stacked, fin_carried, fin_wo = step.fn(
+                feed_vals, donated_vals, kept_vals, ro_vals, keys, wo_init,
                 jnp.float32(0))
-        for n, v in zip(step.donated_names, fin_donated):
+        for n, v in zip(step.carried_names, fin_carried):
             scope.set_var(n, v)
         for n, v in zip(step.wo_names, fin_wo):
             scope.set_var(n, v)

@@ -518,6 +518,19 @@ class Program:
                         op.set_attr("use_global_stats", True)
         return p
 
+    def memory_plan(self, feed_names: Sequence[str] = (),
+                    fetch_names: Sequence[str] = (), batch_size: int = 1):
+        """Static peak-memory plan for the global block: a linear-scan
+        estimate of live bytes per op index with weights / gradients /
+        optimizer state / activations split out (the analysis layer of the
+        reference's ir/memory_optimize_pass family). ``-1`` dims resolve to
+        ``batch_size``. See ``paddle_tpu.analysis.liveness.memory_plan``
+        and ``tools/mem_report.py``."""
+        from .analysis.liveness import memory_plan as _memory_plan
+
+        return _memory_plan(self, feed_names=feed_names,
+                            fetch_names=fetch_names, batch_size=batch_size)
+
     def list_vars(self):
         for blk in self.blocks:
             yield from blk.vars.values()
@@ -679,7 +692,10 @@ def _user_call_site() -> str:
     op (reference op_call_stack.cc InsertCallStackInfo)."""
     f = sys._getframe(1)
     while f is not None:
-        fn = f.f_code.co_filename
+        # normpath: the tools/ CLIs import the package via a "tools/.."
+        # sys.path entry, leaving ".." in co_filename — unnormalized it
+        # never prefix-matches _PKG_DIR and every op blames framework.py
+        fn = os.path.normpath(f.f_code.co_filename)
         if not fn.startswith(_PKG_DIR):
             return f"{fn}:{f.f_lineno} in {f.f_code.co_name}"
         f = f.f_back

@@ -34,6 +34,7 @@ import numpy as np
 
 from ..core.registry import IOSpec, register_op
 from ..lowering import lower_block
+from ..core.types import jnp_dtype
 from .common import out, x
 
 EMPTY = "@EMPTY@"
@@ -107,8 +108,8 @@ class TensorArrayVal:
 
     def length(self):
         if self.buffered:
-            return self.size.reshape((1,)).astype(jnp.int64)
-        return jnp.asarray([len(self.entries)], jnp.int64)
+            return self.size.reshape((1,)).astype(jnp_dtype("int64"))
+        return jnp.asarray([len(self.entries)], jnp_dtype("int64"))
 
     def stack(self):
         """Dense [T, ...] view (T = capacity in buffer mode, padded)."""
@@ -544,7 +545,7 @@ def _beam_search(ctx, ins, attrs):
     nbk, k = scores.shape
     batch = nbk // beam
     if ids is None:
-        ids = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int64), (nbk, k))
+        ids = jnp.broadcast_to(jnp.arange(k, dtype=jnp_dtype("int64")), (nbk, k))
     if not attrs.get("is_accumulated", True):
         scores = pre_scores[:, None] + jnp.log(
             jnp.clip(scores, 1e-20, None))
@@ -560,11 +561,11 @@ def _beam_search(ctx, ins, attrs):
     top_scores, top_pos = jax.lax.top_k(flat_scores, beam)   # [B, beam]
     src_beam = top_pos // k                                  # local parent
     within = top_pos % k
-    parent = (jnp.arange(batch, dtype=jnp.int64)[:, None] * beam
-              + src_beam.astype(jnp.int64))                  # global row
+    parent = (jnp.arange(batch, dtype=jnp_dtype("int64"))[:, None] * beam
+              + src_beam.astype(jnp_dtype("int64")))      # global row
     sel_ids = jnp.take_along_axis(
         cand_ids.reshape(batch, beam * k), top_pos, axis=1)
-    return {"selected_ids": [sel_ids.reshape(-1, 1).astype(jnp.int64)],
+    return {"selected_ids": [sel_ids.reshape(-1, 1).astype(jnp_dtype("int64"))],
             "selected_scores": [top_scores.reshape(-1, 1)],
             "parent_idx": [parent.reshape(-1)]}
 
@@ -606,7 +607,7 @@ def _beam_search_decode(ctx, ins, attrs):
         ptr = parents[t][ptr]
         return ptr, (idt, sct)
 
-    init = jnp.arange(nbk, dtype=jnp.int64)
+    init = jnp.arange(nbk, dtype=jnp_dtype("int64"))
     _, (out_ids, out_scores) = jax.lax.scan(
         back, init, jnp.arange(T - 1, -1, -1))
     # scan walked backwards: reverse to chronological order

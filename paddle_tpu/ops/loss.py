@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.types import jnp_dtype
 from .common import IOSpec, out, register_op, x
 
 
@@ -180,8 +181,8 @@ def _auc(ctx, ins, attrs):
     p1 = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 else pred.reshape(-1)
     lbl = label.reshape(-1).astype(jnp.float32)
     bins = jnp.clip((p1 * nt).astype(jnp.int32), 0, nt)
-    pos_add = jnp.zeros((nt + 1,), jnp.int64).at[bins].add(lbl.astype(jnp.int64))
-    neg_add = jnp.zeros((nt + 1,), jnp.int64).at[bins].add((1 - lbl).astype(jnp.int64))
+    pos_add = jnp.zeros((nt + 1,), jnp_dtype("int64")).at[bins].add(lbl.astype(jnp_dtype("int64")))
+    neg_add = jnp.zeros((nt + 1,), jnp_dtype("int64")).at[bins].add((1 - lbl).astype(jnp_dtype("int64")))
     pos = pos_stat.reshape(-1) + pos_add
     neg = neg_stat.reshape(-1) + neg_add
     # trapezoid over thresholds descending

@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.types import jnp_dtype
 from .common import IOSpec, out, register_op, x
 from .tensor import np_dtype as _np_dtype
 
@@ -133,7 +134,7 @@ def _unique_with_counts(ctx, ins, attrs):
 @register_op("size", inputs=[IOSpec("Input", no_grad=True)],
              outputs=["Out"], grad=None)
 def _size(ctx, ins, attrs):
-    return out(jnp.asarray(int(np.prod(x(ins, "Input").shape)), jnp.int64))
+    return out(jnp.asarray(int(np.prod(x(ins, "Input").shape)), jnp_dtype("int64")))
 
 
 @register_op("is_empty", inputs=[IOSpec("X", no_grad=True)],
@@ -168,7 +169,7 @@ def _random_crop(ctx, ins, attrs):
     begin = [0] * (xv.ndim - k) + starts
     sizes = list(xv.shape[:xv.ndim - k]) + shape
     res = jax.lax.dynamic_slice(xv, begin, sizes)
-    return {"Out": [res], "SeedOut": [jnp.zeros((1,), jnp.int64)]}
+    return {"Out": [res], "SeedOut": [jnp.zeros((1,), jnp_dtype("int64"))]}
 
 
 # ---------------------------------------------------------------------------
@@ -344,7 +345,7 @@ def _sampling_id(ctx, ins, attrs):
     key = (jax.random.key(attrs["seed"]) if attrs.get("seed")
            else ctx.rng())
     return out(jax.random.categorical(
-        key, jnp.log(jnp.maximum(xv, 1e-20)), axis=1).astype(jnp.int64))
+        key, jnp.log(jnp.maximum(xv, 1e-20)), axis=1).astype(jnp_dtype("int64")))
 
 
 @register_op("similarity_focus", inputs=[IOSpec("X", no_grad=True)],
@@ -381,7 +382,7 @@ def _hash(ctx, ins, attrs):
         for j in range(flat.shape[1]):
             h = (h ^ flat[:, j]) * jnp.uint32(16777619)
         outs.append(h % jnp.uint32(attrs["mod_by"]))
-    res = jnp.stack(outs, axis=1).astype(jnp.int64)
+    res = jnp.stack(outs, axis=1).astype(jnp_dtype("int64"))
     return out(res.reshape(xv.shape[0], int(attrs["num_hash"]), 1))
 
 
@@ -451,7 +452,7 @@ def _cross_entropy2(ctx, ins, attrs):
     safe = jnp.where(li == ignore, 0, li)
     match = jnp.take_along_axis(xv, safe, axis=-1)
     y = jnp.where(li == ignore, 0.0, -jnp.log(jnp.maximum(match, 1e-20)))
-    return {"Y": [y], "XShape": [jnp.asarray(xv.shape, jnp.int64)],
+    return {"Y": [y], "XShape": [jnp.asarray(xv.shape, jnp_dtype("int64"))],
             "MatchX": [match]}
 
 

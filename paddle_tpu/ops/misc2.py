@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.types import jnp_dtype
 from .common import IOSpec, out, register_op, x
 
 
@@ -344,7 +345,7 @@ def _sampled_softmax_ce(ctx, ins, attrs):
     lse = jax.nn.logsumexp(picked, axis=1, keepdims=True)
     prob = jnp.exp(picked - lse)
     loss = (lse[:, 0] - picked[:, 0]).reshape(b, 1)
-    return {"Samples": [samples.astype(jnp.int64)],
+    return {"Samples": [samples.astype(jnp_dtype("int64"))],
             "Probabilities": [prob], "Loss": [loss]}
 
 

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.special import logsumexp
 
+from ..core.types import jnp_dtype
 from .common import IOSpec, out, register_op, x
 
 # ---------------------------------------------------------------------------
@@ -155,12 +156,12 @@ def _crf_decoding(ctx, ins, attrs):
             [first_tag[:, None], tags.transpose(1, 0)], axis=1)
     else:
         path = last_tag[:, None]
-    path = jnp.where(mask, path, 0).astype(jnp.int64)
+    path = jnp.where(mask, path, 0).astype(jnp_dtype("int64"))
 
     label = x(ins, "Label")
     if label is not None:
         lbl = _canon_label(label)
-        return out(jnp.where(mask, (path == lbl).astype(jnp.int64), 0),
+        return out(jnp.where(mask, (path == lbl).astype(jnp_dtype("int64")), 0),
                    "ViterbiPath")
     return out(path, "ViterbiPath")
 
@@ -241,7 +242,7 @@ def _nce(ctx, ins, attrs):
         cost = cost * sw.reshape(-1)
     return {"Cost": [cost.reshape(b, 1)],
             "SampleLogits": [jax.nn.sigmoid(logits)],
-            "SampleLabels": [all_ids.astype(jnp.int64)]}
+            "SampleLabels": [all_ids.astype(jnp_dtype("int64"))]}
 
 
 # ---------------------------------------------------------------------------
@@ -347,7 +348,7 @@ def _edit_distance(ctx, ins, attrs):
     if attrs.get("normalized"):
         dist = dist / jnp.maximum(rlen.astype(dist.dtype), 1.0)
     return {"Out": [dist.reshape(b, 1).astype(jnp.float32)],
-            "SequenceNum": [jnp.array([b], jnp.int64)]}
+            "SequenceNum": [jnp.array([b], jnp_dtype("int64"))]}
 
 
 # ---------------------------------------------------------------------------
@@ -387,7 +388,7 @@ def _ctc_align(ctx, ins, attrs):
     bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
     outp = outp.at[bidx, pos].set(inp, mode="drop")
     out_len = jnp.sum(keep, axis=1).astype(jnp.int32)
-    return {"Output": [outp.astype(jnp.int64)], "OutputLength": [out_len]}
+    return {"Output": [outp.astype(jnp_dtype("int64"))], "OutputLength": [out_len]}
 
 
 # ---------------------------------------------------------------------------
@@ -497,6 +498,6 @@ def _chunk_eval(ctx, ins, attrs):
     return {"Precision": [as1(prec, jnp.float32)],
             "Recall": [as1(rec, jnp.float32)],
             "F1-Score": [as1(f1, jnp.float32)],
-            "NumInferChunks": [as1(n_inf, jnp.int64)],
-            "NumLabelChunks": [as1(n_lab, jnp.int64)],
-            "NumCorrectChunks": [as1(n_correct, jnp.int64)]}
+            "NumInferChunks": [as1(n_inf, jnp_dtype("int64"))],
+            "NumLabelChunks": [as1(n_lab, jnp_dtype("int64"))],
+            "NumCorrectChunks": [as1(n_correct, jnp_dtype("int64"))]}

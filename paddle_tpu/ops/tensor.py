@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.types import np_dtype
+from ..core.types import jnp_dtype, np_dtype
 from .common import IOSpec, out, register_op, x
 
 
@@ -26,7 +26,7 @@ def _shape_from_attr(ins, attrs):
              attrs={"shape": [], "value": 0.0, "dtype": "float32", "force_cpu": False})
 def _fill_constant(ctx, ins, attrs):
     shape = _shape_from_attr(ins, attrs)
-    dt = np_dtype(attrs["dtype"])
+    dt = jnp_dtype(attrs["dtype"])
     return out(jnp.full(shape, attrs["value"], dtype=dt))
 
 
@@ -51,7 +51,7 @@ def _fill_constant_bsl(ctx, ins, attrs):
     inp = x(ins, "Input")
     shape = list(attrs["shape"])
     shape[attrs.get("output_dim_idx", 0)] = inp.shape[attrs.get("input_dim_idx", 0)]
-    return out(jnp.full(tuple(shape), attrs["value"], dtype=np_dtype(attrs["dtype"])))
+    return out(jnp.full(tuple(shape), attrs["value"], dtype=jnp_dtype(attrs["dtype"])))
 
 
 @register_op("fill_zeros_like", inputs=["X"], outputs=["Out"], grad=None)
@@ -66,7 +66,7 @@ def _fill_zeros_like(ctx, ins, attrs):
 def _uniform_random(ctx, ins, attrs):
     shape = _shape_from_attr(ins, attrs)
     key = jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng()
-    return out(jax.random.uniform(key, shape, dtype=np_dtype(attrs["dtype"]),
+    return out(jax.random.uniform(key, shape, dtype=jnp_dtype(attrs["dtype"]),
                                   minval=attrs["min"], maxval=attrs["max"]))
 
 
@@ -77,7 +77,7 @@ def _uniform_random(ctx, ins, attrs):
 def _gaussian_random(ctx, ins, attrs):
     shape = _shape_from_attr(ins, attrs)
     key = jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng()
-    sample = jax.random.normal(key, shape, dtype=np_dtype(attrs["dtype"]))
+    sample = jax.random.normal(key, shape, dtype=jnp_dtype(attrs["dtype"]))
     return out(sample * attrs["std"] + attrs["mean"])
 
 
@@ -89,7 +89,7 @@ def _truncated_gaussian_random(ctx, ins, attrs):
     shape = _shape_from_attr(ins, attrs)
     key = jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng()
     sample = jax.random.truncated_normal(key, -2.0, 2.0, shape,
-                                         dtype=np_dtype(attrs["dtype"]))
+                                         dtype=jnp_dtype(attrs["dtype"]))
     return out(sample * attrs["std"] + attrs["mean"])
 
 
@@ -101,8 +101,11 @@ def _assign(ctx, ins, attrs):
 @register_op("assign_value", outputs=["Out"],
              attrs={"shape": [], "dtype": "float32", "values": []}, grad=None)
 def _assign_value(ctx, ins, attrs):
+    # host-side numpy keeps full width (a >2**31 int64 constant would
+    # OverflowError under numpy 2); narrowing happens at the jnp boundary
     vals = np.asarray(attrs["values"], dtype=np_dtype(attrs["dtype"]))
-    return out(jnp.asarray(vals.reshape(attrs["shape"])))
+    return out(jnp.asarray(vals.reshape(attrs["shape"]),
+                           dtype=jnp_dtype(attrs["dtype"])))
 
 
 @register_op("shape", inputs=["Input"], outputs=["Out"], grad=None)
@@ -251,7 +254,7 @@ def _one_hot(ctx, ins, attrs):
     if ids.ndim >= 2 and ids.shape[-1] == 1:
         ids = jnp.squeeze(ids, -1)
     return out(jax.nn.one_hot(ids.astype(jnp.int32), attrs["depth"],
-                              dtype=np_dtype(attrs["dtype"])))
+                              dtype=jnp_dtype(attrs["dtype"])))
 
 
 def _lookup_table_grad(ctx, ins, attrs):
@@ -309,19 +312,19 @@ def _lookup_table_v2(ctx, ins, attrs):
              grad=None)
 def _top_k(ctx, ins, attrs):
     vals, idx = jax.lax.top_k(x(ins), attrs["k"])
-    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [vals], "Indices": [idx.astype(jnp_dtype("int64"))]}
 
 
 @register_op("arg_max", inputs=["X"], outputs=["Out"], attrs={"axis": -1},
              grad=None)
 def _arg_max(ctx, ins, attrs):
-    return out(jnp.argmax(x(ins), axis=attrs["axis"]).astype(jnp.int64))
+    return out(jnp.argmax(x(ins), axis=attrs["axis"]).astype(jnp_dtype("int64")))
 
 
 @register_op("arg_min", inputs=["X"], outputs=["Out"], attrs={"axis": -1},
              grad=None)
 def _arg_min(ctx, ins, attrs):
-    return out(jnp.argmin(x(ins), axis=attrs["axis"]).astype(jnp.int64))
+    return out(jnp.argmin(x(ins), axis=attrs["axis"]).astype(jnp_dtype("int64")))
 
 
 @register_op("argsort", inputs=["X"], outputs=["Out", "Indices"],
@@ -331,7 +334,7 @@ def _argsort(ctx, ins, attrs):
     axis = attrs["axis"]
     idx = jnp.argsort(xv, axis=axis, descending=attrs.get("descending", False))
     return {"Out": [jnp.take_along_axis(xv, idx, axis=axis)],
-            "Indices": [idx.astype(jnp.int64)]}
+            "Indices": [idx.astype(jnp_dtype("int64"))]}
 
 
 @register_op("cumsum", inputs=["X"], outputs=["Out"],
@@ -378,7 +381,7 @@ def _range(ctx, ins, attrs):
                 "range op: Start/End/Step must be compile-time constants "
                 "under XLA (static shapes); pass numbers, not computed "
                 "tensors") from e
-    return out(jnp.arange(st, en, sp, dtype=np_dtype(attrs.get("dtype", "float32"))))
+    return out(jnp.arange(st, en, sp, dtype=jnp_dtype(attrs.get("dtype", "float32"))))
 
 
 @register_op("increment", inputs=["X"], outputs=["Out"], attrs={"step": 1.0},
@@ -457,7 +460,7 @@ def _eye(ctx, ins, attrs):
     n = attrs["num_rows"]
     m = attrs["num_columns"]
     m = n if m is None or m < 0 else m
-    return out(jnp.eye(n, m, dtype=np_dtype(attrs["dtype"])))
+    return out(jnp.eye(n, m, dtype=jnp_dtype(attrs["dtype"])))
 
 
 @register_op("pad2d", inputs=[IOSpec("X")], outputs=["Out"],
@@ -516,7 +519,7 @@ def _uniform_random_bsl(ctx, ins, attrs):
         inp.shape[attrs.get("input_dim_idx", 0)]
     key = jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng()
     return out(jax.random.uniform(key, tuple(shape),
-                                  dtype=np_dtype(attrs["dtype"]),
+                                  dtype=jnp_dtype(attrs["dtype"]),
                                   minval=attrs["min"], maxval=attrs["max"]))
 
 
@@ -534,4 +537,4 @@ def _gaussian_random_bsl(ctx, ins, attrs):
     key = jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng()
     return out(attrs["mean"] + attrs["std"]
                * jax.random.normal(key, tuple(shape),
-                                   dtype=np_dtype(attrs["dtype"])))
+                                   dtype=jnp_dtype(attrs["dtype"])))

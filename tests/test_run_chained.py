@@ -84,6 +84,37 @@ def test_chained_inference_no_state():
                                        np.asarray(one), rtol=1e-6)
 
 
+def test_chained_fetched_param_threads_without_donation():
+    """A fetched parameter is donation-unsafe (PT500): run_chained must keep
+    it OUT of the donated jit args but still thread it through the scan
+    carry — reading it as a loop-invariant would hand every iteration the
+    stale pre-run value."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        loss = _build()
+        main, startup = (fluid.default_main_program(),
+                         fluid.default_startup_program())
+        param = next(v.name for v in main.global_block.vars.values()
+                     if type(v).__name__ == "Parameter"
+                     and v.name.endswith(".w_0"))
+        feed = _feed()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            stacked = exe.run_chained(main, feed=feed,
+                                      fetch_list=[loss, param], steps=3)
+        step = next(s for k, s in exe._cache.items() if k[0] == "chained")
+        assert param not in step.donated_names  # liveness refused donation
+        assert param in step.kept_names and param in step.carried_names
+        ws = np.asarray(stacked[1])
+        assert ws.shape[0] == 3
+        # the param moves every step (carried, not loop-invariant), and the
+        # scope ends at the last fetched value
+        assert not np.array_equal(ws[0], ws[1])
+        assert not np.array_equal(ws[1], ws[2])
+        np.testing.assert_allclose(scope.numpy(param), ws[2], rtol=1e-6)
+
+
 def test_scope_serial_keys_cache_not_id():
     """r5 advisor finding: the compile cache keyed on id(scope), which can
     alias after GC hands a dead scope's address to a fresh Scope. Scopes now
