@@ -175,7 +175,16 @@ def main():
     """Sections run independently: one that RAISES never loses the others
     and the JSON line still prints (a section that hangs is still fatal —
     only the external driver's timeout can reap that)."""
+    from paddle_tpu import monitor
+
     extra = {"protocol": PROTOCOL}
+
+    # compile visibility for the BENCH trajectory: every compile the bench
+    # pays is recorded via the monitor hook API (docs/OBSERVABILITY.md) so
+    # a perf regression can be split into "compute got slower" vs "we
+    # started recompiling"
+    compile_log = []
+    hook = monitor.add_hook(on_compile=lambda rec: compile_log.append(rec))
 
     def section(key, fn):
         t0 = time.time()
@@ -201,6 +210,20 @@ def main():
     if infer_bf16_ms is not None:
         extra["resnet50_infer_bs128_bf16_ms"] = round(infer_bf16_ms, 2)
         extra["ref_v100_fp16_infer_bs128_ms"] = REF_FP16_INFER_MS
+    monitor.remove_hook(hook)
+    extra["monitor"] = {
+        "compiles": len(compile_log),
+        "recompiles": monitor.recompile_count(),
+        "compile_seconds_total": round(sum(
+            (rec.trace_lower_s or 0) + (rec.compile_s or 0)
+            for rec in compile_log), 2),
+        "chained_iterations": int(monitor.metric_value(
+            "executor_chained_iterations_total") or 0),
+        "steps": {p: int(monitor.metric_value("executor_steps_total",
+                                              path=p) or 0)
+                  for p in ("run", "chained")},
+    }
+
     if bert is not None:
         bert_steps, bert_tflops, bert_bs, bert_sl = bert
         extra["bert_base_train_bf16_steps_per_s"] = round(bert_steps, 3)

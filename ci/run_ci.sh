@@ -27,6 +27,23 @@ echo "== peak-memory plan + PT5xx liveness gate (JSON report is the CI artifact)
 JAX_PLATFORMS=cpu python tools/mem_report.py --check \
   --json "${CI_ARTIFACT_DIR:-.}/ci_mem_report.json"
 
+echo "== executor metrics + recompile gate (paddle_tpu.monitor; JSON artifact)"
+JAX_PLATFORMS=cpu python tools/metrics_report.py --check \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_metrics_report.json"
+echo "== recompile tripwire negative control (the gate must FAIL here)"
+FORCED_LOG="${CI_ARTIFACT_DIR:-.}/ci_forced_recompile.log"
+if JAX_PLATFORMS=cpu python tools/metrics_report.py --check \
+     --force-recompile 3 > "$FORCED_LOG" 2>&1; then
+  echo "metrics_report --check did NOT fail on a forced-recompile scenario" >&2
+  exit 1
+fi
+# non-zero exit must be the gate tripping, not the scenario crashing
+if ! grep -q -- "-> FAIL" "$FORCED_LOG"; then
+  echo "forced-recompile control exited non-zero WITHOUT tripping the gate:" >&2
+  tail -20 "$FORCED_LOG" >&2
+  exit 1
+fi
+
 echo "== unit tests (CPU, 8 virtual devices; FLAGS_check_program on via conftest)"
 python -m pytest tests/ -q -x
 

@@ -34,6 +34,7 @@ from . import optimizer
 from . import transpiler
 from .transpiler import (DistributeTranspiler, DistributeTranspilerConfig,
                          memory_optimize, release_memory)
+from . import monitor
 from . import profiler
 from . import regularizer
 from . import analysis

@@ -9,11 +9,13 @@ execution rides the TPU executor (and CompiledProgram when num_devices>1).
 from __future__ import annotations
 
 import os
+import time
 from typing import Callable, Optional
 
 import numpy as np
 
 from .. import io as io_mod
+from .. import monitor as _monitor
 from ..executor import CPUPlace, Executor, Scope, scope_guard
 from ..framework import Program, program_guard
 from ..parallel.compiled_program import CompiledProgram
@@ -103,6 +105,9 @@ class Trainer:
             io_mod.save_checkpoint(self.exe, self._ckpt_path(serial),
                                    self.main_program,
                                    meta={"step": self._step})
+        if _monitor.enabled():
+            _monitor.counter("trainer_checkpoints_total",
+                            "checkpoints written by contrib.Trainer").inc()
         # rotate (reference keeps max_num_checkpoints)
         for old in self._serials()[:-self._ckpt.max_num_checkpoints]:
             import shutil
@@ -134,11 +139,25 @@ class Trainer:
                     begin = BeginStepEvent(epoch, step)
                     event_handler(begin)
                     fetches = [self.loss.name] if begin.fetch_metrics else []
+                    t0 = time.perf_counter()
                     vals = self.exe.run(prog, feed=feeder.feed(batch),
                                         fetch_list=fetches)
                     metrics = [float(np.asarray(v).reshape(-1)[0])
                                for v in vals]
                     self._step += 1
+                    if _monitor.enabled():
+                        _monitor.counter(
+                            "trainer_steps_total",
+                            "steps run by contrib.Trainer.train").inc()
+                        _monitor.histogram(
+                            "trainer_step_seconds",
+                            "Trainer step wall time (feed build + executor "
+                            "dispatch + metric fetch)").observe(
+                            time.perf_counter() - t0)
+                        if metrics:
+                            _monitor.gauge(
+                                "trainer_last_loss",
+                                "most recent fetched loss").set(metrics[0])
                     event_handler(EndStepEvent(epoch, step, metrics))
                     if self._ckpt and self._step % \
                             self._ckpt.step_interval == 0:

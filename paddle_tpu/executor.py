@@ -20,15 +20,18 @@ from __future__ import annotations
 
 import contextlib
 import itertools
+import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import monitor as _monitor
 from .core.types import np_dtype
 from .framework import Program, Variable, default_main_program
 from .lowering import LowerCtx, lower_block, lower_op
+from .profiler import RecordEvent
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard", "CPUPlace",
            "TPUPlace", "CUDAPlace"]
@@ -126,6 +129,34 @@ def _shape_dtype_sig(v):
     return (tuple(a.shape), str(a.dtype))
 
 
+def _feed_host_bytes(v) -> int:
+    """Bytes a feed will move host->device, 0 for device-resident arrays.
+    Never calls np.asarray on a jax array (that WOULD be the transfer)."""
+    if isinstance(v, np.ndarray):
+        return int(v.nbytes)
+    if hasattr(v, "devices") or hasattr(v, "device_buffer"):
+        return 0  # jax array: already on (some) device
+    try:
+        return int(np.asarray(v).nbytes)
+    except Exception:
+        return 0
+
+
+def _live_bytes(vals) -> int:
+    return sum(int(getattr(v, "nbytes", 0) or 0) for v in vals)
+
+
+def _own_donated(vals):
+    """Donated step inputs must be jax Arrays the executor OWNS. A host
+    numpy array (e.g. a param the user planted with scope.set_var) can be
+    zero-copy-aliased by the runtime when alignment allows; donating that
+    aliased buffer lets XLA write the step's output INTO the user's array.
+    jit dispatch quietly skips donation for non-Array args; the AOT
+    executables used since the monitor PR do not, so copy once here — the
+    same host->device copy jit would have made."""
+    return [v if isinstance(v, jax.Array) else jnp.array(v) for v in vals]
+
+
 _global_scope = Scope()
 
 
@@ -167,6 +198,16 @@ class _CompiledStep:
         # recycled), so this is no longer needed to prevent id() aliasing —
         # it is kept for debugging: step.program names the compiled source
         self.program = None
+        # state_out vars that are read but NOT donated (donation-unsafe,
+        # e.g. a fetched param): their old buffer is copied, not reused
+        self.kept_names: List[str] = []
+        # AOT executable: None = not yet lowered, False = AOT unavailable
+        # (fall back to jit dispatch), else the jax Compiled object. Set by
+        # Executor._ensure_executable on the first call so trace+lower and
+        # XLA-compile are timed as separate monitor stages.
+        self._aot = None
+        # pending monitor CompileRecord awaiting stage timings
+        self._compile_event = None
 
 
 def analyze_block_io(block, feed_names: set, fetch_names) -> dict:
@@ -440,8 +481,22 @@ class Executor:
                        for f in (fetch_list or [])]
 
         self._verify_once(program, fetch_names)
+        mrec = _monitor.step_begin("run", program)
+        try:
+            return self._run_body(program, feed, fetch_names, scope,
+                                  return_numpy, use_program_cache, mrec)
+        finally:
+            # always paired with step_begin — a step that raises (e.g.
+            # FLAGS_check_nan_inf) still counts and hooks stay in sync
+            _monitor.step_end(mrec)
+
+    def _run_body(self, program, feed, fetch_names, scope, return_numpy,
+                  use_program_cache, mrec):
         step = self._get_compiled(program, feed, fetch_names, scope,
-                                  use_cache=use_program_cache)
+                                  use_cache=use_program_cache, mrec=mrec)
+        if mrec is not None:
+            mrec.fetch_names = tuple(fetch_names)
+            mrec.feed_bytes = sum(_feed_host_bytes(v) for v in feed.values())
         feed_vals = [self._to_device_array(feed[n], program, n)
                      for n in step.feed_names]
 
@@ -464,14 +519,39 @@ class Executor:
 
         donated_vals = read_state(step.donated_names)
         ro_vals = read_state(step.ro_names)
+        if mrec is not None:
+            mrec.donated_buffers = len(step.donated_names)
+            mrec.kept_buffers = len(step.kept_names)
+            mrec.donated_bytes = _live_bytes(donated_vals)
         key = jax.random.key(self._next_seed(program))
         with jax.default_device(self.place.jax_device()):
-            result = step.fn(feed_vals, donated_vals, ro_vals, key)
+            # inside default_device so the one-time host->device copy of
+            # planted numpy state lands on THIS executor's device
+            donated_vals = _own_donated(donated_vals)
+            fn = self._ensure_executable(
+                step, (feed_vals, donated_vals, ro_vals, key))
+            with RecordEvent("executor::step"):
+                try:
+                    result = fn(feed_vals, donated_vals, ro_vals, key)
+                except (TypeError, ValueError):
+                    if fn is step.fn:
+                        raise
+                    # the AOT executable is stricter than jit dispatch:
+                    # structure mismatches raise TypeError, committed-to-
+                    # another-device shardings raise ValueError — both are
+                    # checked before any buffer is donated, so retry
+                    # through jit (which adapts) and stop using the AOT
+                    # fast path for this step
+                    step._aot = False
+                    result = step.fn(feed_vals, donated_vals, ro_vals, key)
         fetches, new_state = unpack_step_result(step, result, scope)
         for n, v in zip(step.state_out_names, new_state):
             scope.set_var(n, v)
         if return_numpy:
-            return [np.asarray(v) for v in fetches]
+            outs = [np.asarray(v) for v in fetches]
+            if mrec is not None:
+                mrec.fetch_bytes = _live_bytes(outs)
+            return outs
         return list(fetches)
 
     def run_chained(
@@ -517,6 +597,22 @@ class Executor:
         key = ("chained", self._program_fingerprint(program), feed_sig,
                tuple(fetch_names), int(steps), scope._serial)
         step = self._cache.get(key)
+        mrec = _monitor.step_begin("chained", program)
+        if mrec is not None:
+            mrec.cache_hit = step is not None
+            mrec.iterations = int(steps)
+            mrec.fetch_names = tuple(fetch_names)
+            mrec.feed_bytes = sum(_feed_host_bytes(v) for v in feed.values())
+        _monitor.record_cache_lookup("chained", step is not None)
+        try:
+            return self._run_chained_body(program, feed, fetch_names, steps,
+                                          scope, return_numpy, key, step,
+                                          feed_sig, mrec)
+        finally:
+            _monitor.step_end(mrec)
+
+    def _run_chained_body(self, program, feed, fetch_names, steps, scope,
+                          return_numpy, key, step, feed_sig, mrec):
         if step is None:
             block = program.global_block
             io = analyze_block_io(block, set(feed.keys()), fetch_names)
@@ -580,6 +676,16 @@ class Executor:
                                  ro_names, io["state_out"],
                                  tuple(fetch_names))
             step.program = program
+            step._compile_event = _monitor.observe_compile(
+                "chained", program,
+                components={
+                    "program": self._program_fingerprint(program)[1:],
+                    "feed_signature": feed_sig,
+                    "fetch_list": tuple(fetch_names),
+                    "scope": scope._serial,
+                    "steps": int(steps),
+                },
+                donated_names=io["donated"])
             step.kept_names = kept
             step.carried_names = carried
             step.wo_names = wo_names
@@ -633,16 +739,34 @@ class Executor:
                         "not use this timing as a per-step measurement",
                         RuntimeWarning, stacklevel=3)
         wo_init = [jnp.zeros(s, d) for s, d in step.wo_shapes]
+        if mrec is not None:
+            mrec.donated_buffers = len(step.donated_names)
+            mrec.kept_buffers = len(step.kept_names)
+            mrec.donated_bytes = _live_bytes(donated_vals)
         with jax.default_device(self.place.jax_device()):
-            stacked, fin_carried, fin_wo = step.fn(
-                feed_vals, donated_vals, kept_vals, ro_vals, keys, wo_init,
-                jnp.float32(0))
+            # inside default_device so the one-time host->device copy of
+            # planted numpy state lands on THIS executor's device
+            donated_vals = _own_donated(donated_vals)
+            args = (feed_vals, donated_vals, kept_vals, ro_vals, keys,
+                    wo_init, jnp.float32(0))
+            fn = self._ensure_executable(step, args)
+            with RecordEvent("executor::run_chained"):
+                try:
+                    stacked, fin_carried, fin_wo = fn(*args)
+                except (TypeError, ValueError):
+                    if fn is step.fn:
+                        raise
+                    step._aot = False
+                    stacked, fin_carried, fin_wo = step.fn(*args)
         for n, v in zip(step.carried_names, fin_carried):
             scope.set_var(n, v)
         for n, v in zip(step.wo_names, fin_wo):
             scope.set_var(n, v)
         if return_numpy:
-            return [np.asarray(v) for v in stacked]
+            outs = [np.asarray(v) for v in stacked]
+            if mrec is not None:
+                mrec.fetch_bytes = _live_bytes(outs)
+            return outs
         return list(stacked)
 
     def close(self):
@@ -676,7 +800,7 @@ class Executor:
                 sum(len(b.ops) for b in program.blocks))
 
     def _get_compiled(self, program, feed, fetch_names, scope,
-                      use_cache: bool = True) -> _CompiledStep:
+                      use_cache: bool = True, mrec=None) -> _CompiledStep:
         feed_sig = tuple(sorted(
             (n,) + _shape_dtype_sig(v) for n, v in feed.items()
         ))
@@ -684,10 +808,26 @@ class Executor:
 
         key = (self._program_fingerprint(program), feed_sig,
                tuple(fetch_names), scope._serial, flag("check_nan_inf"))
-        if use_cache and key in self._cache:
+        hit = use_cache and key in self._cache
+        _monitor.record_cache_lookup("run", hit)
+        if mrec is not None:
+            mrec.cache_hit = hit
+        if hit:
             return self._cache[key]
-        step = self._compile(program, set(feed.keys()), fetch_names, scope)
+        with RecordEvent("executor::build_step"):
+            step = self._compile(program, set(feed.keys()), fetch_names,
+                                 scope)
         step.program = program
+        step._compile_event = _monitor.observe_compile(
+            "run", program,
+            components={
+                "program": self._program_fingerprint(program)[1:],
+                "feed_signature": feed_sig,
+                "fetch_list": tuple(fetch_names),
+                "scope": scope._serial,
+                "flags": (("check_nan_inf", flag("check_nan_inf")),),
+            },
+            donated_names=step.donated_names)
         self._cache[key] = step
         return step
 
@@ -702,5 +842,32 @@ class Executor:
         jitted = jax.jit(step_fn, donate_argnums=(1,))
         step = _CompiledStep(jitted, io["feed_order"], io["donated"],
                              io["ro"], io["state_out"], tuple(fetch_names))
+        step.kept_names = [n for n in io["ro"] if n in io["state_out"]]
         step.nan_check_meta = meta  # filled lazily at first trace
         return step
+
+    def _ensure_executable(self, step: _CompiledStep, args):
+        """First call of a freshly compiled step: run the AOT pipeline
+        explicitly so jaxpr-trace+StableHLO-lower and XLA-compile are
+        measured as separate monitor stages (TVM's lesson in PAPERS.md:
+        treat compile and execute cost as first-class, separately measured
+        quantities). The compiled executable is kept on the step — later
+        calls through it also skip jit dispatch overhead. If lowering
+        raises (user shape errors surface at trace time) the jit path is
+        used instead so the original diagnostic is what the user sees."""
+        if step._aot is None:
+            ev, step._compile_event = step._compile_event, None
+            t_trace = t_compile = None
+            try:
+                t0 = time.perf_counter()
+                with RecordEvent("executor::trace_lower"):
+                    lowered = step.fn.lower(*args)
+                t1 = time.perf_counter()
+                with RecordEvent("executor::xla_compile"):
+                    step._aot = lowered.compile()
+                t_trace, t_compile = t1 - t0, time.perf_counter() - t1
+            except Exception:
+                step._aot = False
+            finally:
+                _monitor.complete_compile(ev, t_trace, t_compile)
+        return step._aot or step.fn

@@ -24,6 +24,19 @@ _DEFS: Dict[str, tuple] = {
                       "findings raise ProgramVerificationError with the op's "
                       "build site — see docs/ANALYSIS.md). On by default in "
                       "the test suite via tests/conftest.py"),
+    "monitor": (bool, True,
+                "runtime metrics collection (paddle_tpu.monitor): executor "
+                "counters/histograms, step hooks, recompilation diagnostics "
+                "— docs/OBSERVABILITY.md. Off disables all collection"),
+    "log_compiles": (bool, False,
+                     "log every executor compile (INFO) and recompile "
+                     "(WARNING, with the changed cache-key component and "
+                     "program build site) — the jax_log_compiles analogue "
+                     "for the step cache"),
+    "recompile_warn_threshold": (int, 3,
+                                 "warn via logging once a single program "
+                                 "has recompiled this many times, even "
+                                 "without FLAGS_log_compiles (0 disables)"),
     "paddle_num_threads": (int, 1, "host threads hint (XLA owns scheduling)"),
     "seq_bucket_sizes": (str, "", "override DataFeeder varlen buckets, csv"),
     "conv_use_nhwc": (str, "auto",

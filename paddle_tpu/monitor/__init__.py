@@ -1,0 +1,196 @@
+"""paddle_tpu.monitor — executor runtime metrics, recompilation diagnostics
+and structured step tracing.
+
+The reference stack's profiler/CUPTI layer (platform/profiler.h,
+device_tracer.h) gave Fluid per-event visibility; this package is the
+TPU-native equivalent for the rebuild's actual hot paths, which are
+otherwise opaque: the jit compile cache, liveness-gated buffer donation,
+and ``run_chained``. Three layers:
+
+* ``registry`` — thread-safe counters/gauges/histograms with JSON and
+  Prometheus-text exporters (``monitor.get_registry()``,
+  ``monitor.metric_value()``).
+* ``hooks`` — ``monitor.add_hook(on_step_begin=..., on_step_end=...,
+  on_compile=...)`` subscription API fed by the executor.
+* ``recompile`` — cache-miss diagnostics that name *which* cache-key
+  component changed (program / feed_signature / fetch_list / scope /
+  flags) with build-site attribution, and warn after
+  ``FLAGS_recompile_warn_threshold`` recompiles of one program.
+
+Everything is on by default (``FLAGS_monitor=0`` disables collection —
+hooks, counters and diagnostics all go quiet). Executor spans additionally
+flow through ``profiler.RecordEvent`` so they land in the host timeline
+(``tools/timeline.py``). ``tools/metrics_report.py`` dumps
+``monitor.snapshot()`` as the CI metrics artifact and gates on unexpected
+recompiles. Metric names and semantics: docs/OBSERVABILITY.md.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Dict, Optional
+
+from .hooks import (CompileRecord, Hook, StepRecord, add_hook, clear_hooks,
+                    dispatch, remove_hook)
+from .recompile import RecompileTracker, build_site, get_tracker
+from .registry import (DEFAULT_TIME_BUCKETS, Counter, Gauge, Histogram,
+                       MetricFamily, MetricsRegistry, counter, gauge,
+                       get_registry, histogram, metric_value)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricFamily", "MetricsRegistry",
+    "StepRecord", "CompileRecord", "Hook", "RecompileTracker",
+    "add_hook", "remove_hook", "clear_hooks", "get_registry", "counter",
+    "gauge", "histogram", "metric_value", "enabled", "record_cache_lookup",
+    "observe_compile", "complete_compile", "step_begin", "step_end",
+    "recompile_events", "recompile_count", "snapshot", "reset",
+    "get_tracker", "build_site",
+]
+
+_step_counter = itertools.count()
+
+
+def enabled() -> bool:
+    """Collection master switch (``FLAGS_monitor``, default on)."""
+    from ..flags import flag
+
+    return bool(flag("monitor"))
+
+
+# -- executor instrumentation entry points ---------------------------------
+# (called from Executor.run / run_chained / CompiledProgram; every entry
+# no-ops when FLAGS_monitor=0)
+
+def record_cache_lookup(path: str, hit: bool) -> None:
+    if not enabled():
+        return
+    counter("executor_cache_lookups_total",
+            "compile-cache lookups by path and result").labels(
+        path=path, result="hit" if hit else "miss").inc()
+
+
+def observe_compile(path: str, program, components: Dict[str, Any],
+                    donated_names=()) -> Optional[CompileRecord]:
+    """Record a compile-cache miss: compile counters, recompile diagnosis
+    (component diff + build site), static donated-bytes estimate from the
+    program's var shapes (``memory_plan`` sizing). Returns the record so
+    the caller can fill stage timings and fire ``complete_compile``."""
+    if not enabled():
+        return None
+    serial = int(getattr(program, "_serial", -1))
+    rec = get_tracker().observe(path, serial, build_site(program),
+                                components)
+    counter("executor_compiles_total",
+            "compile-cache misses that built a new executable").labels(
+        path=path).inc()
+    if rec.recompile:
+        counter("executor_recompiles_total",
+                "compiles of a program that was already compiled — the "
+                "TPU perf tripwire").labels(path=path).inc()
+    try:
+        from ..analysis.liveness import _var_bytes
+
+        blk = program.global_block
+        rec.donated_bytes_est = sum(
+            _var_bytes(blk.var(n), 1)[0]
+            for n in donated_names if blk.has_var(n))
+    except Exception:
+        pass
+    return rec
+
+
+def complete_compile(rec: Optional[CompileRecord],
+                     trace_lower_s: Optional[float],
+                     compile_s: Optional[float]) -> None:
+    """Attach stage timings to a compile record, export them, and fire the
+    ``on_compile`` hooks. Called once per compile, after the executable
+    exists (or after stage timing failed — timings then stay None)."""
+    if rec is None:
+        return
+    rec.trace_lower_s = trace_lower_s
+    rec.compile_s = compile_s
+    if trace_lower_s is not None:
+        histogram("executor_compile_seconds",
+                  "compile-stage wall time by stage").labels(
+            stage="trace_lower").observe(trace_lower_s)
+    if compile_s is not None:
+        histogram("executor_compile_seconds",
+                  "compile-stage wall time by stage").labels(
+            stage="xla_compile").observe(compile_s)
+    dispatch("compile", rec)
+
+
+def step_begin(path: str, program) -> Optional[StepRecord]:
+    if not enabled():
+        return None
+    rec = StepRecord(path=path,
+                     program_serial=int(getattr(program, "_serial", -1)),
+                     step_index=next(_step_counter))
+    rec._t0 = time.perf_counter()
+    dispatch("step_begin", rec)
+    return rec
+
+
+def step_end(rec: Optional[StepRecord]) -> None:
+    if rec is None:
+        return
+    if rec.duration_s is None and hasattr(rec, "_t0"):
+        rec.duration_s = time.perf_counter() - rec._t0
+    p = {"path": rec.path}
+    counter("executor_steps_total", "executor dispatches").labels(**p).inc()
+    if rec.path == "chained":
+        counter("executor_chained_iterations_total",
+                "scanned iterations inside run_chained dispatches").inc(
+            rec.iterations)
+    if rec.duration_s is not None:
+        histogram("executor_step_seconds",
+                  "wall time of one executor dispatch (feed packing + "
+                  "device step + state writeback)").labels(**p).observe(
+            rec.duration_s)
+    if rec.feed_bytes:
+        counter("executor_feed_bytes_total",
+                "host->device feed transfer bytes").inc(rec.feed_bytes)
+    if rec.fetch_bytes:
+        counter("executor_fetch_bytes_total",
+                "device->host fetch transfer bytes").inc(rec.fetch_bytes)
+    if rec.donated_buffers:
+        counter("executor_donated_buffers_total",
+                "state buffers donated to XLA (updated in place)").inc(
+            rec.donated_buffers)
+    if rec.kept_buffers:
+        counter("executor_kept_buffers_total",
+                "state buffers kept/copied (donation-unsafe)").inc(
+            rec.kept_buffers)
+    if rec.donated_bytes:
+        counter("executor_donated_bytes_total",
+                "live bytes of donated buffers").inc(rec.donated_bytes)
+    dispatch("step_end", rec)
+
+
+# -- introspection ---------------------------------------------------------
+
+def recompile_events(recompiles_only: bool = True):
+    """Recent compile records (bounded ring; newest last)."""
+    return get_tracker().events(recompiles_only=recompiles_only)
+
+
+def recompile_count(program_serial: Optional[int] = None) -> int:
+    return get_tracker().recompile_count(program_serial)
+
+
+def snapshot() -> dict:
+    """One JSON-ready view of everything: metrics + compile/recompile
+    events. This is the metrics artifact ``tools/metrics_report.py``
+    writes for CI."""
+    return {
+        "metrics": get_registry().to_dict(),
+        "compile_events": [e.to_dict() for e in
+                           get_tracker().events()],
+        "recompiles_total": get_tracker().recompile_count(),
+    }
+
+
+def reset() -> None:
+    """Clear metrics and recompile history (hooks stay subscribed)."""
+    get_registry().reset()
+    get_tracker().reset()

@@ -23,9 +23,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import monitor as _monitor
 from ..framework import Program, Variable
-from ..executor import _shape_dtype_sig
+from ..executor import _feed_host_bytes, _live_bytes, _shape_dtype_sig
 from ..lowering import LowerCtx, lower_block
+from ..profiler import RecordEvent
 
 __all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy", "data_parallel_mesh"]
 
@@ -126,7 +128,23 @@ class CompiledProgram:
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in (fetch_list or [])]
         program = self._program
-        step = self._get_compiled(exe, program, feed, fetch_names, scope)
+        mrec = _monitor.step_begin("parallel", program)
+        try:
+            return self._run_body(exe, program, feed, fetch_names, scope,
+                                  return_numpy, mrec)
+        finally:
+            # paired with step_begin even when the step raises
+            _monitor.step_end(mrec)
+
+    def _run_body(self, exe, program, feed, fetch_names, scope,
+                  return_numpy, mrec):
+        if mrec is not None:
+            mrec.fetch_names = tuple(fetch_names)
+        step = self._get_compiled(exe, program, feed, fetch_names, scope,
+                                  mrec=mrec)
+        if mrec is not None:
+            mrec.feed_bytes = sum(_feed_host_bytes(v)
+                                  for v in feed.values())
         multiproc = jax.process_count() > 1
         batch_shard = NamedSharding(
             self._mesh, P("dp") if "dp" in self._mesh.axis_names else P())
@@ -155,8 +173,14 @@ class CompiledProgram:
             return vals
 
         key = jax.random.key(exe._next_seed(program))
-        result = step.fn(feed_vals, read(step.donated_names),
-                         read(step.ro_names), key)
+        donated_vals = read(step.donated_names)
+        if mrec is not None:
+            mrec.donated_buffers = len(step.donated_names)
+            mrec.kept_buffers = len(step.kept_names)
+            mrec.donated_bytes = _live_bytes(donated_vals)
+        with RecordEvent("executor::parallel_step"):
+            result = step.fn(feed_vals, donated_vals,
+                             read(step.ro_names), key)
         from ..executor import unpack_step_result
 
         fetches, new_state = unpack_step_result(step, result, scope,
@@ -164,10 +188,14 @@ class CompiledProgram:
         for n, v in zip(step.state_out_names, new_state):
             scope.set_var(n, v)
         if return_numpy:
-            return [_fetch_numpy(v) for v in fetches]
+            outs = [_fetch_numpy(v) for v in fetches]
+            if mrec is not None:
+                mrec.fetch_bytes = _live_bytes(outs)
+            return outs
         return list(fetches)
 
-    def _get_compiled(self, exe, program, feed, fetch_names, scope):
+    def _get_compiled(self, exe, program, feed, fetch_names, scope,
+                      mrec=None):
         feed_sig = tuple(sorted(
             (n,) + _shape_dtype_sig(v) for n, v in feed.items()
         ))
@@ -175,10 +203,28 @@ class CompiledProgram:
 
         key = (exe._program_fingerprint(program), feed_sig,
                tuple(fetch_names), flag("check_nan_inf"))
-        if key in self._cache:
+        hit = key in self._cache
+        _monitor.record_cache_lookup("parallel", hit)
+        if mrec is not None:
+            mrec.cache_hit = hit
+        if hit:
             return self._cache[key]
-        step = self._compile(program, set(feed.keys()), fetch_names, scope)
+        with RecordEvent("executor::build_step"):
+            step = self._compile(program, set(feed.keys()), fetch_names,
+                                 scope)
         step.program = program
+        # the data-parallel path keeps jit dispatch (shardings make the
+        # AOT fast path fiddly across process topologies), so the compile
+        # event completes here without stage timings
+        _monitor.complete_compile(_monitor.observe_compile(
+            "parallel", program,
+            components={
+                "program": exe._program_fingerprint(program)[1:],
+                "feed_signature": feed_sig,
+                "fetch_list": tuple(fetch_names),
+                "flags": (("check_nan_inf", flag("check_nan_inf")),),
+            },
+            donated_names=step.donated_names), None, None)
         self._cache[key] = step
         return step
 
@@ -250,6 +296,7 @@ class CompiledProgram:
                          out_shardings=out_shardings)
         step = _CompiledStep(jitted, io["feed_order"], io["donated"],
                              io["ro"], io["state_out"], tuple(fetch_names))
+        step.kept_names = [n for n in io["ro"] if n in io["state_out"]]
         step.state_shardings = state_shardings
         step.nan_check_meta = nan_meta
         return step

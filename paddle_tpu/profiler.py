@@ -6,54 +6,89 @@ tracer (platform/profiler.h, device_tracer.h). On TPU the equivalent
 substrate is the XLA/XPlane trace: jax.profiler.trace writes a TensorBoard-
 loadable (and Perfetto-convertible) dump — the tools/timeline.py role.
 Op-level host annotations use jax.profiler.TraceAnnotation, the RecordEvent
-analogue.
+analogue; ``paddle_tpu.monitor`` feeds its executor spans (compile stages,
+step dispatch) through RecordEvent too, so they land in the same timeline.
+
+Thread-safety: all host-side state (event aggregates, span list, tid map)
+is guarded by one module lock — RecordEvent is used from DataLoader worker
+threads while ``stop_profiler`` snapshots and clears from the main thread.
+
+``stop_profiler`` returns the host report as a structure (and logs it via
+``logging``) so test suites and servers can consume it; the printed table
+remains for CLI compatibility with the reference.
 """
 from __future__ import annotations
 
 import contextlib
+import logging
+import threading
 import time
 from collections import defaultdict
+from typing import Optional
 
 import jax
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
            "RecordEvent", "cuda_profiler", "npu_profiler"]
 
-_trace_dir = None
+log = logging.getLogger("paddle_tpu.profiler")
+
+# one lock for every piece of host-side profiling state: RecordEvent
+# exits on worker threads race stop_profiler's snapshot-and-clear
+_lock = threading.Lock()
+_trace_dir: Optional[str] = None
 _host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
 _host_spans = []  # (name, t0_s, t1_s, small_tid) while profiling
 _tid_map = {}     # thread ident -> stable small timeline row id
-import threading as _threading  # noqa: E402
-
-_tid_lock = _threading.Lock()
 
 
 def start_profiler(state="All", tracer_option=None, profile_path="/tmp/profile"):
     global _trace_dir
-    _trace_dir = profile_path
+    with _lock:
+        _trace_dir = profile_path
     jax.profiler.start_trace(profile_path)
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    """Stop tracing; aggregate and emit the host-side event report.
+
+    Returns ``{"events": [{"name", "calls", "total_s", "avg_s"}, ...],
+    "sorted_by": key, "spans_path": path-or-None}`` — the structure a test
+    suite or server asserts on. The same table is logged at INFO on the
+    ``paddle_tpu.profiler`` logger and printed (reference CLI behaviour).
+    """
     global _trace_dir
     jax.profiler.stop_trace()
-    _print_host_report(sorted_key)
+    with _lock:
+        trace_dir, _trace_dir = _trace_dir, None
+        spans = list(_host_spans)
+        _host_spans.clear()
+        events = {name: (cnt, tot)
+                  for name, (cnt, tot) in _host_events.items()}
+    report = _host_report(events, sorted_key)
+    table = _format_host_report(report)
+    if table:
+        log.info("host event report (sorted by %s):\n%s",
+                 report["sorted_by"], table)
+        print(table)
     # span dump consumed by tools/timeline.py (the reference writes
     # profiler.proto consumed by its timeline.py; here it is JSON)
-    if _trace_dir:
+    if trace_dir:
         import json
         import os
 
-        with open(os.path.join(_trace_dir, "host_events.json"), "w") as f:
+        path = os.path.join(trace_dir, "host_events.json")
+        with open(path, "w") as f:
             json.dump([{"name": n, "t0": a, "t1": b, "tid": t}
-                       for n, a, b, t in _host_spans], f)
-    _trace_dir = None
-    _host_spans.clear()
+                       for n, a, b, t in spans], f)
+        report["spans_path"] = path
+    return report
 
 
 def reset_profiler():
-    _host_events.clear()
-    _host_spans.clear()
+    with _lock:
+        _host_events.clear()
+        _host_spans.clear()
 
 
 @contextlib.contextmanager
@@ -82,33 +117,39 @@ class RecordEvent:
     def __exit__(self, *exc):
         self._ann.__exit__(*exc)
         t1 = time.perf_counter()
-        rec = _host_events[self.name]
-        rec[0] += 1
-        rec[1] += t1 - self._t0
-        if _trace_dir is not None:
-            import threading
-
-            ident = threading.get_ident()
-            with _tid_lock:
+        ident = threading.get_ident()
+        with _lock:
+            rec = _host_events[self.name]
+            rec[0] += 1
+            rec[1] += t1 - self._t0
+            if _trace_dir is not None:
                 tid = _tid_map.setdefault(ident, len(_tid_map))
-            _host_spans.append((self.name, self._t0, t1, tid))
+                _host_spans.append((self.name, self._t0, t1, tid))
         return False
 
 
-def _print_host_report(sorted_key=None):
-    if not _host_events:
-        return
-    rows = [(name, cnt, tot, tot / cnt)
-            for name, (cnt, tot) in _host_events.items()]
-    if sorted_key in ("total", None):
-        rows.sort(key=lambda r: -r[2])
-    elif sorted_key == "calls":
-        rows.sort(key=lambda r: -r[1])
-    elif sorted_key == "ave":
-        rows.sort(key=lambda r: -r[3])
-    print(f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(s)':>12}")
-    for name, cnt, tot, avg in rows:
-        print(f"{name:<40}{cnt:>8}{tot:>12.6f}{avg:>12.6f}")
+def _host_report(events, sorted_key=None) -> dict:
+    rows = [{"name": name, "calls": cnt, "total_s": tot,
+             "avg_s": tot / cnt}
+            for name, (cnt, tot) in events.items()]
+    sorted_by = sorted_key or "total"
+    if sorted_by == "total":
+        rows.sort(key=lambda r: -r["total_s"])
+    elif sorted_by == "calls":
+        rows.sort(key=lambda r: -r["calls"])
+    elif sorted_by == "ave":
+        rows.sort(key=lambda r: -r["avg_s"])
+    return {"events": rows, "sorted_by": sorted_by, "spans_path": None}
+
+
+def _format_host_report(report: dict) -> str:
+    if not report["events"]:
+        return ""
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(s)':>12}"]
+    for r in report["events"]:
+        lines.append(f"{r['name']:<40}{r['calls']:>8}"
+                     f"{r['total_s']:>12.6f}{r['avg_s']:>12.6f}")
+    return "\n".join(lines)
 
 
 @contextlib.contextmanager

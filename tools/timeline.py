@@ -30,8 +30,10 @@ def convert(profile_path: str, timeline_path: str) -> int:
         return 1
     with open(src) as f:
         spans = json.load(f)
-    if spans:
-        base = min(s["t0"] for s in spans)
+    # an empty profile (no RecordEvent fired while tracing) is still a
+    # valid run: emit a well-formed empty trace rather than NameError-ing
+    # on the unbound base timestamp
+    base = min(s["t0"] for s in spans) if spans else 0.0
     events = [{
         "name": s["name"],
         "ph": "X",
