@@ -44,6 +44,24 @@ if ! grep -q -- "-> FAIL" "$FORCED_LOG"; then
   exit 1
 fi
 
+echo "== chaos gate (paddle_tpu.resilience: kill-mid-checkpoint + transient"
+echo "   compile faults must resume from the last verified checkpoint)"
+JAX_PLATFORMS=cpu python tools/chaos_check.py --check \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_chaos_report.json"
+echo "== chaos negative control (retries disabled: the gate must FAIL here)"
+CHAOS_NEG_LOG="${CI_ARTIFACT_DIR:-.}/ci_chaos_negative.log"
+if JAX_PLATFORMS=cpu python tools/chaos_check.py --check \
+     --negative-control > "$CHAOS_NEG_LOG" 2>&1; then
+  echo "chaos_check --check did NOT fail with retries disabled" >&2
+  exit 1
+fi
+# non-zero exit must be the gate tripping, not the harness crashing
+if ! grep -q -- "-> FAIL" "$CHAOS_NEG_LOG"; then
+  echo "chaos negative control exited non-zero WITHOUT tripping the gate:" >&2
+  tail -20 "$CHAOS_NEG_LOG" >&2
+  exit 1
+fi
+
 echo "== unit tests (CPU, 8 virtual devices; FLAGS_check_program on via conftest)"
 python -m pytest tests/ -q -x
 

@@ -37,6 +37,7 @@ from .transpiler import (DistributeTranspiler, DistributeTranspilerConfig,
 from . import monitor
 from . import profiler
 from . import regularizer
+from . import resilience
 from . import analysis
 from .core import registry as op_registry
 from .flags import get_flags, set_flags

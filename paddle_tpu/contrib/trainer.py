@@ -16,6 +16,7 @@ import numpy as np
 
 from .. import io as io_mod
 from .. import monitor as _monitor
+from .. import resilience as _resilience
 from ..executor import CPUPlace, Executor, Scope, scope_guard
 from ..framework import Program, program_guard
 from ..parallel.compiled_program import CompiledProgram
@@ -80,7 +81,7 @@ class Trainer:
         self._step = 0
         with scope_guard(self.scope):
             self.exe.run(self.startup_program)
-        if self._ckpt and self._serials():
+        if self._ckpt:
             self._load_latest()
 
     # -- checkpoints -----------------------------------------------------
@@ -88,19 +89,15 @@ class Trainer:
         return os.path.join(self._ckpt.checkpoint_dir, f"checkpoint_{serial}")
 
     def _serials(self):
-        if not os.path.isdir(self._ckpt.checkpoint_dir):
-            return []
-        out = []
-        for n in os.listdir(self._ckpt.checkpoint_dir):
-            if n.startswith("checkpoint_"):
-                try:
-                    out.append(int(n.split("_")[1]))
-                except ValueError:
-                    pass
-        return sorted(out)
+        """Serials of ``checkpoint_<int>`` DIRECTORIES only, ascending.
+        Stray files, torn temp dirs and non-numeric entries in the
+        checkpoint dir are ignored (resilience.iter_serials)."""
+        return [s for s, _ in
+                _resilience.iter_serials(self._ckpt.checkpoint_dir)]
 
     def _save_checkpoint(self):
-        serial = (self._serials()[-1] + 1) if self._serials() else 0
+        serials = self._serials()
+        serial = (serials[-1] + 1) if serials else 0
         with scope_guard(self.scope):
             io_mod.save_checkpoint(self.exe, self._ckpt_path(serial),
                                    self.main_program,
@@ -108,18 +105,35 @@ class Trainer:
         if _monitor.enabled():
             _monitor.counter("trainer_checkpoints_total",
                             "checkpoints written by contrib.Trainer").inc()
-        # rotate (reference keeps max_num_checkpoints)
-        for old in self._serials()[:-self._ckpt.max_num_checkpoints]:
+        # rotate (reference keeps max_num_checkpoints); never the serial
+        # just written, even with max_num_checkpoints=1 or a racing writer
+        # that renumbered the listing under us. <=0 keeps full history
+        # (the pre-resilience [:-0] behavior, kept on purpose)
+        keep = int(self._ckpt.max_num_checkpoints)
+        if keep <= 0:
+            return
+        for old in self._serials()[:-keep]:
+            if old == serial:
+                continue
             import shutil
 
             shutil.rmtree(self._ckpt_path(old), ignore_errors=True)
 
     def _load_latest(self):
-        serial = self._serials()[-1]
+        """Resume from the newest checkpoint that passes verification,
+        walking serials newest -> oldest past torn/corrupt ones (each skip
+        counts on ``trainer_ckpt_fallback_total`` and logs its PT6xx
+        diagnostic). An empty or garbage-only checkpoint dir starts fresh
+        at step 0 instead of crashing."""
         with scope_guard(self.scope):
-            meta = io_mod.load_checkpoint(self.exe, self._ckpt_path(serial),
-                                          self.main_program)
+            meta, serial, skipped = _resilience.load_latest_checkpoint(
+                self.exe, self._ckpt.checkpoint_dir,
+                main_program=self.main_program, scope=self.scope)
+        if meta is None:
+            self._step = 0
+            return None
         self._step = int(meta.get("step", 0))
+        return serial
 
     # -- the loop --------------------------------------------------------
     def train(self, num_epochs: int, event_handler: Callable,

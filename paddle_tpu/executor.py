@@ -32,6 +32,9 @@ from .core.types import np_dtype
 from .framework import Program, Variable, default_main_program
 from .lowering import LowerCtx, lower_block, lower_op
 from .profiler import RecordEvent
+from .resilience import faults as _faults
+from .resilience import nonfinite as _nonfinite
+from .resilience.retry import RetryExhaustedError, call_with_retry
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard", "CPUPlace",
            "TPUPlace", "CUDAPlace"]
@@ -144,6 +147,13 @@ def _feed_host_bytes(v) -> int:
 
 def _live_bytes(vals) -> int:
     return sum(int(getattr(v, "nbytes", 0) or 0) for v in vals)
+
+
+def _has_nonfinite(v) -> bool:
+    """Host-side coarse finite check (run_chained's FLAGS_check_nan_inf —
+    a device->host pull per state var, only when the flag is on)."""
+    a = np.asarray(v)
+    return a.dtype.kind in "fc" and not np.isfinite(a).all()
 
 
 def _own_donated(vals):
@@ -288,25 +298,42 @@ def make_step_fn(block, io: dict, fetch_names, mesh=None,
     return step_fn
 
 
-def unpack_step_result(step, result, scope, to_host=np.asarray):
+def unpack_step_result(step, result, scope, to_host=np.asarray, *,
+                       path="run", exe=None, rollback=None):
     """Shared FLAGS_check_nan_inf protocol for every execution path: a
-    3-tuple result carries the per-op finite flags. On failure the step's
-    outputs are written back FIRST (inputs were donated — without this the
-    scope would reference deleted buffers and the session would be unusable
-    after catching the error), then FloatingPointError names the op."""
+    3-tuple result carries the per-op finite flags.
+
+    On a tripped check the outcome depends on ``FLAGS_nan_inf_policy``
+    (resilience.nonfinite). With ``rollback=None`` (policy ``raise``, or a
+    path that could not preserve pre-step buffers) the step's outputs are
+    written back FIRST (inputs were donated — without this the scope would
+    reference deleted buffers and the session would be unusable after
+    catching the error), then FloatingPointError names the op. With a
+    ``rollback`` list of ``(name, pre-step value)`` pairs the step is
+    DROPPED instead: the scope is restored bit-exactly, the skip is
+    counted (``steps_skipped_nonfinite_total``), and ``(fetches, None)``
+    is returned — the caller must skip its state writeback."""
     if len(result) != 3:
         return result
     fetches, new_state, ok_vec = result
     ok = np.asarray(to_host(ok_vec))
-    if not ok.all():
+    if ok.all():
+        _nonfinite.record_clean(exe)
+        return fetches, new_state
+    bad = int(np.argmin(ok))
+    meta = getattr(step, "nan_check_meta", None) or []
+    label = meta[bad] if bad < len(meta) else f"check #{bad}"
+    if rollback is None:
         for n, v in zip(step.state_out_names, new_state):
             scope.set_var(n, v)
-        bad = int(np.argmin(ok))
-        meta = getattr(step, "nan_check_meta", None) or []
-        label = meta[bad] if bad < len(meta) else f"check #{bad}"
         raise FloatingPointError(
             f"FLAGS_check_nan_inf: non-finite value in {label}")
-    return fetches, new_state
+    for n, v in rollback:
+        scope.set_var(n, v)
+    # counted AFTER the restore so even skip->raise escalation leaves the
+    # scope holding the pre-step values
+    _nonfinite.record_skip(path, label, exe)
+    return fetches, None
 
 
 def make_pipeline_step_fn(block, io: dict, fetch_names, mesh=None,
@@ -519,15 +546,27 @@ class Executor:
 
         donated_vals = read_state(step.donated_names)
         ro_vals = read_state(step.ro_names)
+        # step-site fault probe fires BEFORE any buffer is donated, so an
+        # injected step failure leaves the scope fully usable
+        _faults.fault_point("step")
         if mrec is not None:
             mrec.donated_buffers = len(step.donated_names)
             mrec.kept_buffers = len(step.kept_names)
             mrec.donated_bytes = _live_bytes(donated_vals)
         key = jax.random.key(self._next_seed(program))
+        rollback = None
         with jax.default_device(self.place.jax_device()):
-            # inside default_device so the one-time host->device copy of
-            # planted numpy state lands on THIS executor's device
-            donated_vals = _own_donated(donated_vals)
+            if step.nan_check_meta is not None \
+                    and _nonfinite.rollback_active():
+                # nan_inf_policy=skip|zero_grad must be able to restore the
+                # EXACT pre-step bits, but donation consumes the inputs —
+                # so donate fresh device copies and keep the originals
+                rollback = list(zip(step.donated_names, donated_vals))
+                donated_vals = [jnp.array(v) for v in donated_vals]
+            else:
+                # inside default_device so the one-time host->device copy
+                # of planted numpy state lands on THIS executor's device
+                donated_vals = _own_donated(donated_vals)
             fn = self._ensure_executable(
                 step, (feed_vals, donated_vals, ro_vals, key))
             with RecordEvent("executor::step"):
@@ -544,9 +583,12 @@ class Executor:
                     # fast path for this step
                     step._aot = False
                     result = step.fn(feed_vals, donated_vals, ro_vals, key)
-        fetches, new_state = unpack_step_result(step, result, scope)
-        for n, v in zip(step.state_out_names, new_state):
-            scope.set_var(n, v)
+        fetches, new_state = unpack_step_result(step, result, scope,
+                                                path="run", exe=self,
+                                                rollback=rollback)
+        if new_state is not None:
+            for n, v in zip(step.state_out_names, new_state):
+                scope.set_var(n, v)
         if return_numpy:
             outs = [np.asarray(v) for v in fetches]
             if mrec is not None:
@@ -578,8 +620,12 @@ class Executor:
 
         The same feed batch is used for every iteration (perf measurement /
         overfit-one-batch semantics); real input pipelines stream via
-        DataLoader + ``run``. FLAGS_check_nan_inf is not supported here —
-        per-op flags would have to be stacked across steps; use ``run``.
+        DataLoader + ``run``. FLAGS_check_nan_inf here is a COARSE whole-
+        dispatch check (per-op flags would have to be stacked across
+        steps): the final carried state is checked host-side after the
+        scan, and a trip raises/skips the entire ``steps``-iteration
+        dispatch per FLAGS_nan_inf_policy — use ``run`` for per-op
+        provenance.
         """
         program = program or default_main_program()
         scope = scope or global_scope()
@@ -739,14 +785,26 @@ class Executor:
                         "not use this timing as a per-step measurement",
                         RuntimeWarning, stacklevel=3)
         wo_init = [jnp.zeros(s, d) for s, d in step.wo_shapes]
+        # step-site fault probe fires BEFORE donation, scope stays usable
+        _faults.fault_point("step")
+        from .flags import flag
+
+        check = flag("check_nan_inf")
+        rollback = None
         if mrec is not None:
             mrec.donated_buffers = len(step.donated_names)
             mrec.kept_buffers = len(step.kept_names)
             mrec.donated_bytes = _live_bytes(donated_vals)
         with jax.default_device(self.place.jax_device()):
-            # inside default_device so the one-time host->device copy of
-            # planted numpy state lands on THIS executor's device
-            donated_vals = _own_donated(donated_vals)
+            if check and _nonfinite.rollback_active():
+                # pre-dispatch image of the donated carry so a tripped scan
+                # can be dropped bit-exactly (see unpack_step_result)
+                rollback = list(zip(step.donated_names, donated_vals))
+                donated_vals = [jnp.array(v) for v in donated_vals]
+            else:
+                # inside default_device so the one-time host->device copy
+                # of planted numpy state lands on THIS executor's device
+                donated_vals = _own_donated(donated_vals)
             args = (feed_vals, donated_vals, kept_vals, ro_vals, keys,
                     wo_init, jnp.float32(0))
             fn = self._ensure_executable(step, args)
@@ -758,6 +816,30 @@ class Executor:
                         raise
                     step._aot = False
                     stacked, fin_carried, fin_wo = step.fn(*args)
+        if check:
+            bad = next((n for n, v in
+                        list(zip(step.carried_names, fin_carried))
+                        + list(zip(step.wo_names, fin_wo))
+                        if _has_nonfinite(v)), None)
+            if bad is not None:
+                label = (f"final state '{bad}' after {steps} scanned "
+                         f"iteration(s)")
+                if rollback is None:
+                    for n, v in zip(step.carried_names, fin_carried):
+                        scope.set_var(n, v)
+                    for n, v in zip(step.wo_names, fin_wo):
+                        scope.set_var(n, v)
+                    raise FloatingPointError(
+                        f"FLAGS_check_nan_inf: non-finite value in {label} "
+                        f"(run_chained coarse check; use run for per-op "
+                        f"provenance)")
+                for n, v in rollback:
+                    scope.set_var(n, v)
+                _nonfinite.record_skip("chained", label, self)
+                if return_numpy:
+                    return [np.asarray(v) for v in stacked]
+                return list(stacked)
+            _nonfinite.record_clean(self)
         for n, v in zip(step.carried_names, fin_carried):
             scope.set_var(n, v)
         for n, v in zip(step.wo_names, fin_wo):
@@ -787,7 +869,14 @@ class Executor:
                 want = np_dtype(blk.var(name).dtype)
                 if arr.dtype != want and arr.dtype.kind == want.kind:
                     arr = arr.astype(want)
-            return jnp.asarray(arr)
+
+            def _put():
+                # transient-site: host->device transfer can fail for
+                # infrastructure reasons (preempted device, RPC hiccup);
+                # retry with backoff, never for shape/dtype errors
+                _faults.fault_point("device_put")
+                return jnp.asarray(arr)
+            return call_with_retry("device_put", _put)
         return value
 
     def _program_fingerprint(self, program: Program) -> tuple:
@@ -858,16 +947,36 @@ class Executor:
         if step._aot is None:
             ev, step._compile_event = step._compile_event, None
             t_trace = t_compile = None
-            try:
+
+            def _build():
+                # transient-site: compiles hit flaky infra (preempted
+                # backend, cache-server hiccups) — retried with backoff
+                _faults.fault_point("compile")
                 t0 = time.perf_counter()
                 with RecordEvent("executor::trace_lower"):
                     lowered = step.fn.lower(*args)
                 t1 = time.perf_counter()
                 with RecordEvent("executor::xla_compile"):
-                    step._aot = lowered.compile()
-                t_trace, t_compile = t1 - t0, time.perf_counter() - t1
+                    compiled = lowered.compile()
+                return compiled, t1 - t0, time.perf_counter() - t1
+
+            try:
+                step._aot, t_trace, t_compile = \
+                    call_with_retry("compile", _build)
+            except RetryExhaustedError as e:
+                if isinstance(e.last_error, _faults.InjectedFault):
+                    # a scripted fault outlasting the retry budget must
+                    # ABORT (the chaos gate's negative control), not fall
+                    # back to a jit path the plan never faulted
+                    raise
+                step._aot = False   # real persistent failure: jit fallback
             except Exception:
+                # user trace/shape errors surface through the jit path so
+                # the original diagnostic is what the user sees
                 step._aot = False
             finally:
+                # always paired with the popped record — even a
+                # KeyboardInterrupt mid-compile must not leave the
+                # on_compile hooks waiting forever
                 _monitor.complete_compile(ev, t_trace, t_compile)
         return step._aot or step.fn

@@ -37,6 +37,37 @@ _DEFS: Dict[str, tuple] = {
                                  "warn via logging once a single program "
                                  "has recompiled this many times, even "
                                  "without FLAGS_log_compiles (0 disables)"),
+    "nan_inf_policy": (str, "raise",
+                       "what a tripped FLAGS_check_nan_inf step does: "
+                       "raise (FloatingPointError with op provenance), "
+                       "skip (drop the step, roll state back bit-exactly; "
+                       "nan_inf_max_consecutive_skips trips escalate), "
+                       "zero_grad (skip without escalation — the zero-"
+                       "gradient approximation). docs/RESILIENCE.md"),
+    "nan_inf_max_consecutive_skips": (int, 5,
+                                      "under nan_inf_policy=skip, this many "
+                                      "consecutive dropped steps escalate "
+                                      "to FloatingPointError (0 disables "
+                                      "escalation)"),
+    "fault_plan": (str, "",
+                   "deterministic fault-injection schedule, e.g. "
+                   "'compile:2:RuntimeError,ckpt_write:1:kill' "
+                   "(paddle_tpu.resilience.faults; sites: compile, "
+                   "device_put, step, ckpt_write). Empty disables"),
+    "fault_seed": (int, 0,
+                   "seed for probabilistic fault-plan rules and retry "
+                   "jitter — the same plan+seed replays identically"),
+    "retry_max_attempts": (int, 3,
+                           "attempts (first try included) for transient "
+                           "failures at the compile/device_put sites; 1 "
+                           "disables retry"),
+    "retry_base_delay": (float, 0.05,
+                         "first backoff delay in seconds (doubles per "
+                         "retry, seeded jitter on top)"),
+    "retry_max_delay": (float, 2.0, "backoff delay ceiling in seconds"),
+    "retry_timeout": (float, 30.0,
+                      "per-site wall-clock retry budget in seconds across "
+                      "all attempts (0 = unlimited)"),
     "paddle_num_threads": (int, 1, "host threads hint (XLA owns scheduling)"),
     "seq_bucket_sizes": (str, "", "override DataFeeder varlen buckets, csv"),
     "conv_use_nhwc": (str, "auto",

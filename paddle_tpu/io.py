@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 from typing import List, Optional
 
 import numpy as np
@@ -25,6 +26,17 @@ __all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
 _MANIFEST = "manifest.json"
 
 
+def _ensure_dir(dirname: str) -> None:
+    """makedirs with a CLEAR diagnostic when the target exists as a file
+    (the bare OSError from makedirs names neither the caller nor the fix)."""
+    if os.path.exists(dirname) and not os.path.isdir(dirname):
+        raise ValueError(
+            f"save: dirname '{dirname}' already exists as a FILE — "
+            f"checkpoints and inference models are directories; remove the "
+            f"file or pick another path")
+    os.makedirs(dirname, exist_ok=True)
+
+
 def _vars_of(program: Program, predicate) -> List[Variable]:
     return [v for v in program.list_vars() if predicate(v)]
 
@@ -32,7 +44,7 @@ def _vars_of(program: Program, predicate) -> List[Variable]:
 def _save_var_list(executor, dirname: str, vars_: List[Variable],
                    scope: Optional[Scope], filename: Optional[str]):
     scope = scope or global_scope()
-    os.makedirs(dirname, exist_ok=True)
+    _ensure_dir(dirname)
     manifest = {}
     blobs = {}
     for v in vars_:
@@ -62,10 +74,13 @@ def _load_var_list(executor, dirname: str, vars_: List[Variable],
 
     scope = scope or global_scope()
     manifest_path = os.path.join(dirname, _MANIFEST)
+    manifest = None
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            manifest = json.load(f)
     blobs = {}
-    if filename is not None or (os.path.exists(manifest_path) and
-                                json.load(open(manifest_path)).get("filename")):
-        fname = filename or json.load(open(manifest_path))["filename"]
+    if filename is not None or (manifest and manifest.get("filename")):
+        fname = filename or manifest["filename"]
         with np.load(os.path.join(dirname, fname),
                      allow_pickle=False) as combined:
             wanted = {v.name.replace("/", "__"): v.name for v in vars_}
@@ -74,6 +89,11 @@ def _load_var_list(executor, dirname: str, vars_: List[Variable],
                     raise RuntimeError(
                         f"load: '{name}' missing from checkpoint")
                 blobs[name] = combined[key]
+    # two phases: read + validate EVERYTHING, then commit to the scope —
+    # a shape mismatch on the Nth var must not leave vars 0..N-1 from the
+    # checkpoint mixed with the scope's previous values (recovery walks
+    # rely on a failed load leaving the scope untouched)
+    staged = []
     for v in vars_:
         if blobs:
             arr = blobs[v.name]
@@ -87,7 +107,9 @@ def _load_var_list(executor, dirname: str, vars_: List[Variable],
             raise RuntimeError(
                 f"load: shape mismatch for '{v.name}': checkpoint "
                 f"{arr.shape} vs program {v.shape}")
-        scope.set_var(v.name, jnp.asarray(arr))
+        staged.append((v.name, arr))
+    for name, arr in staged:
+        scope.set_var(name, jnp.asarray(arr))
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,
@@ -173,7 +195,7 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     fetch_names = [t.name if isinstance(t, Variable) else t
                    for t in target_vars]
     pruned = _prune_for_inference(program, feeded_var_names, fetch_names)
-    os.makedirs(dirname, exist_ok=True)
+    _ensure_dir(dirname)
     model_filename = model_filename or "__model__"
     with open(os.path.join(dirname, model_filename), "w") as f:
         json.dump({"program": pruned.to_dict(),
@@ -198,20 +220,69 @@ def load_inference_model(dirname, executor, model_filename=None,
     return program, meta["feed_names"], fetch_vars
 
 
-# convenience full-checkpoint helpers (beyond the reference: adds step/meta)
+# full-checkpoint helpers (beyond the reference CheckpointConfig save: adds
+# step/meta AND crash-safety — see paddle_tpu.resilience.checkpoint and
+# docs/RESILIENCE.md for the failure model and manifest schema)
 def save_checkpoint(executor, dirname, main_program=None, scope=None,
                     meta: dict = None):
-    save_persistables(executor, dirname, main_program, filename="ckpt.npz",
-                      scope=scope)
-    with open(os.path.join(dirname, "meta.json"), "w") as f:
-        json.dump(meta or {}, f)
+    """Crash-safe checkpoint write: everything lands in a temp sibling dir
+    first (``.<name>.tmp.<pid>``), the manifest gains per-file sha256 +
+    param inventory + framework version, files and directories are fsynced,
+    and only then is the temp dir atomically renamed into place. A process
+    killed at ANY point leaves either the complete previous checkpoint or
+    the complete new one at ``dirname`` — never a torn mixture. The torn
+    temp dir a kill leaves behind is ignored by recovery
+    (``resilience.iter_serials``) and overwritten by the next save."""
+    from .resilience import checkpoint as _rck
+    from .resilience.faults import fault_point
+
+    dirname = os.path.normpath(dirname)
+    if os.path.exists(dirname) and not os.path.isdir(dirname):
+        raise ValueError(
+            f"save_checkpoint: '{dirname}' already exists as a FILE — "
+            f"checkpoints are directories")
+    parent = os.path.dirname(os.path.abspath(dirname))
+    os.makedirs(parent, exist_ok=True)
+    tmp = os.path.join(parent, f".{os.path.basename(dirname)}.tmp."
+                               f"{os.getpid()}")
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp, ignore_errors=True)
+    try:
+        save_persistables(executor, tmp, main_program, filename="ckpt.npz",
+                          scope=scope)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta or {}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        # blobs are on disk, manifest/rename have not happened: a kill here
+        # (FLAGS_fault_plan site) is the worst case the design must survive
+        fault_point("ckpt_write")
+        _rck.finalize_manifest(tmp)
+        _rck.atomic_replace_dir(tmp, dirname)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
 
 
-def load_checkpoint(executor, dirname, main_program=None, scope=None) -> dict:
+def load_checkpoint(executor, dirname, main_program=None, scope=None,
+                    verify: bool = True) -> dict:
+    """Verify the checkpoint's manifest (per-file sha256, format version)
+    BEFORE loading a single byte, then restore persistables and return the
+    meta dict. A torn or tampered checkpoint raises
+    ``resilience.CheckpointCorruptError`` with a PT6xx code naming what
+    failed — it never half-loads into the scope. ``verify=False`` skips
+    integrity checks (for checkpoints written by pre-resilience builds)."""
+    if verify:
+        from .resilience import checkpoint as _rck
+
+        _rck.verify_checkpoint(dirname)
     load_persistables(executor, dirname, main_program, filename="ckpt.npz",
                       scope=scope)
     meta_path = os.path.join(dirname, "meta.json")
-    return json.load(open(meta_path)) if os.path.exists(meta_path) else {}
+    if not os.path.exists(meta_path):
+        return {}
+    with open(meta_path) as f:
+        return json.load(f)
 
 
 # reference fluid.io re-exports the data pipeline (python/paddle/fluid/io.py

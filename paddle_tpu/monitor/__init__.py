@@ -17,6 +17,13 @@ and ``run_chained``. Three layers:
   flags) with build-site attribution, and warn after
   ``FLAGS_recompile_warn_threshold`` recompiles of one program.
 
+``paddle_tpu.resilience`` reports through the same registry:
+``resilience_retries_total`` / ``resilience_giveups_total`` (transient-site
+retry), ``resilience_faults_injected_total`` (FLAGS_fault_plan),
+``steps_skipped_nonfinite_total`` (FLAGS_nan_inf_policy) and
+``trainer_ckpt_fallback_total`` (torn-checkpoint recovery) — see
+docs/RESILIENCE.md.
+
 Everything is on by default (``FLAGS_monitor=0`` disables collection —
 hooks, counters and diagnostics all go quiet). Executor spans additionally
 flow through ``profiler.RecordEvent`` so they land in the host timeline

@@ -28,6 +28,9 @@ from ..framework import Program, Variable
 from ..executor import _feed_host_bytes, _live_bytes, _shape_dtype_sig
 from ..lowering import LowerCtx, lower_block
 from ..profiler import RecordEvent
+from ..resilience import faults as _faults
+from ..resilience import nonfinite as _nonfinite
+from ..resilience.retry import call_with_retry
 
 __all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy", "data_parallel_mesh"]
 
@@ -150,16 +153,22 @@ class CompiledProgram:
             self._mesh, P("dp") if "dp" in self._mesh.axis_names else P())
         repl = NamedSharding(self._mesh, P())
         state_shardings = getattr(step, "state_shardings", {})
-        if multiproc:
-            # each trainer feeds its LOCAL batch shard; together they form
-            # the global batch (the reference's FeedAndSplitTensorIntoLocal
-            # Scopes, parallel_executor.cc:75, inverted: feeds are split
-            # before the call, not inside it)
-            feed_vals = [jax.make_array_from_process_local_data(
-                batch_shard, np.asarray(feed[n])) for n in step.feed_names]
-        else:
-            feed_vals = [jnp.asarray(np.asarray(feed[n]))
-                         for n in step.feed_names]
+        def _pack_feed(n):
+            def _put():
+                # device_put fault site + transient retry (host->device)
+                _faults.fault_point("device_put")
+                if multiproc:
+                    # each trainer feeds its LOCAL batch shard; together
+                    # they form the global batch (the reference's
+                    # FeedAndSplitTensorIntoLocalScopes,
+                    # parallel_executor.cc:75, inverted: feeds are split
+                    # before the call, not inside it)
+                    return jax.make_array_from_process_local_data(
+                        batch_shard, np.asarray(feed[n]))
+                return jnp.asarray(np.asarray(feed[n]))
+            return call_with_retry("device_put", _put)
+
+        feed_vals = [_pack_feed(n) for n in step.feed_names]
 
         def read(names):
             vals = []
@@ -174,6 +183,20 @@ class CompiledProgram:
 
         key = jax.random.key(exe._next_seed(program))
         donated_vals = read(step.donated_names)
+        # step-site fault probe fires BEFORE donation, scope stays usable
+        _faults.fault_point("step")
+        rollback = None
+        if step.nan_check_meta is not None and _nonfinite.rollback_active():
+            if all(getattr(v, "is_fully_addressable", True)
+                   for v in donated_vals):
+                # host-side pre-step image: a device-side copy would lose
+                # the mesh sharding; np.asarray gathers the exact bits and
+                # the restore re-shards on the next step's read()
+                rollback = [(n, np.asarray(v))
+                            for n, v in zip(step.donated_names,
+                                            donated_vals)]
+            # multi-process global arrays cannot be host-imaged here; the
+            # policy degrades to raise for this dispatch
         if mrec is not None:
             mrec.donated_buffers = len(step.donated_names)
             mrec.kept_buffers = len(step.kept_names)
@@ -184,9 +207,12 @@ class CompiledProgram:
         from ..executor import unpack_step_result
 
         fetches, new_state = unpack_step_result(step, result, scope,
-                                                to_host=_fetch_numpy)
-        for n, v in zip(step.state_out_names, new_state):
-            scope.set_var(n, v)
+                                                to_host=_fetch_numpy,
+                                                path="parallel", exe=exe,
+                                                rollback=rollback)
+        if new_state is not None:
+            for n, v in zip(step.state_out_names, new_state):
+                scope.set_var(n, v)
         if return_numpy:
             outs = [_fetch_numpy(v) for v in fetches]
             if mrec is not None:
@@ -209,6 +235,13 @@ class CompiledProgram:
             mrec.cache_hit = hit
         if hit:
             return self._cache[key]
+
+        # compile-site fault probe + transient retry (the actual XLA
+        # compile happens lazily at first dispatch on this path; the probe
+        # models the build pipeline's transient failures). Only the probe
+        # is retried: a real build failure must surface its ORIGINAL
+        # diagnostic immediately, exactly like the single-device path
+        call_with_retry("compile", _faults.fault_point, "compile")
         with RecordEvent("executor::build_step"):
             step = self._compile(program, set(feed.keys()), fetch_names,
                                  scope)

@@ -1,0 +1,56 @@
+"""paddle_tpu.resilience — fault tolerance as a first-class subsystem.
+
+The ROADMAP's north star is production-scale training where preemption,
+transient infrastructure failure and the occasional non-finite batch are
+ROUTINE, not fatal (PAPERS.md: data-parallel TPU training at scale only
+works because restart-after-failure is assumed). Four pieces, wired through
+io / executor / contrib.Trainer / monitor:
+
+* :mod:`~paddle_tpu.resilience.checkpoint` — crash-safe checkpoints:
+  ``io.save_checkpoint`` writes into a temp dir, emits a ``manifest.json``
+  with per-file sha256 + param inventory + framework version, fsyncs, then
+  atomically renames; ``io.load_checkpoint`` verifies before loading;
+  ``load_latest_checkpoint`` (used by ``Trainer._load_latest``) walks
+  serials newest->oldest skipping torn/corrupt checkpoints with PT6xx
+  diagnostics instead of crashing or silently loading garbage.
+* :mod:`~paddle_tpu.resilience.faults` — deterministic, seeded fault
+  injection (``FLAGS_fault_plan="compile:2:RuntimeError,ckpt_write:1:kill"``)
+  at the compile / device_put / step / ckpt_write sites. The only way the
+  rest of this subsystem is testable; ``tools/chaos_check.py`` is the CI
+  gate built on it.
+* :mod:`~paddle_tpu.resilience.retry` — exponential backoff + seeded
+  jitter for the transient sites (compile, device transfer), with
+  ``resilience_retries_total`` / ``resilience_giveups_total`` metrics and a
+  per-site wall-clock budget. Shape/dtype/verifier errors never retry.
+* :mod:`~paddle_tpu.resilience.nonfinite` — ``FLAGS_nan_inf_policy =
+  raise|skip|zero_grad``: under ``skip`` a tripped step is dropped with the
+  scope rolled back bit-exactly (donation-aware: the executor donates
+  copies and keeps the originals), N consecutive skips escalate to raise.
+
+Failure model, flag reference and checkpoint format: docs/RESILIENCE.md.
+"""
+from __future__ import annotations
+
+from .checkpoint import (CKPT_CODES, FORMAT_VERSION, CheckpointCorruptError,
+                         atomic_replace_dir, finalize_manifest, iter_serials,
+                         load_latest_checkpoint, verify_checkpoint)
+from .faults import (SITES, FaultPlan, InjectedFault, active_plan,
+                     clear_plan, fault_plan_guard, fault_point, install_plan)
+from .nonfinite import POLICIES
+from .retry import (RetryExhaustedError, RetryPolicy, call_with_retry,
+                    is_transient, policy_for, retrying)
+
+__all__ = [
+    # checkpoint integrity
+    "CheckpointCorruptError", "CKPT_CODES", "FORMAT_VERSION",
+    "verify_checkpoint", "finalize_manifest", "atomic_replace_dir",
+    "iter_serials", "load_latest_checkpoint",
+    # fault injection
+    "FaultPlan", "InjectedFault", "fault_point", "fault_plan_guard",
+    "install_plan", "clear_plan", "active_plan", "SITES",
+    # retry
+    "RetryPolicy", "RetryExhaustedError", "retrying", "call_with_retry",
+    "is_transient", "policy_for",
+    # non-finite degradation
+    "POLICIES",
+]

@@ -1,0 +1,222 @@
+"""Deterministic fault injection (``FLAGS_fault_plan``).
+
+Production TPU training assumes preemption and transient infrastructure
+failure are routine (PAPERS.md, cross-replica sharding paper: restart is a
+first-class event, not an accident). The rest of ``paddle_tpu.resilience``
+— retry/backoff, crash-safe checkpoints, torn-checkpoint fallback — is only
+*testable* if failures can be produced on demand, deterministically, at the
+exact boundaries where real ones occur. This module is that switchboard.
+
+Injection sites (probed via :func:`fault_point`):
+
+=============  ==============================================================
+site           probed where
+=============  ==============================================================
+``compile``    executor AOT build (``Executor._ensure_executable``) and the
+               data-parallel compile (``CompiledProgram._get_compiled``)
+``device_put`` feed host->device transfer (``Executor._to_device_array``,
+               CompiledProgram feed packing)
+``step``       immediately before a compiled step executes (run /
+               run_chained / CompiledProgram)
+``ckpt_write`` inside ``io.save_checkpoint`` after the blobs are written but
+               BEFORE the manifest/rename — a ``kill`` here leaves a torn
+               temp dir, never a torn live checkpoint
+=============  ==============================================================
+
+Plan grammar (``FLAGS_fault_plan``, comma-separated rules)::
+
+    site:N:action     fire on the first N hits of the site
+    site:@K:action    fire exactly on the K-th hit (1-based)
+    site:pX:action    fire with probability X per hit (seeded by
+                      FLAGS_fault_seed — the same plan replays identically)
+
+Actions: an exception class name (``RuntimeError``, ``OSError``,
+``TimeoutError``, ``ConnectionError`` — raised as an *injected* subclass so
+handlers can tell injected faults from real ones), or ``kill`` —
+``os._exit(137)``, a mid-write SIGKILL stand-in that skips every ``finally``
+block exactly like the real signal.
+
+Example: ``FLAGS_fault_plan="compile:2:RuntimeError,ckpt_write:1:kill"``
+makes the first two compile attempts fail transiently (retry/backoff must
+absorb them) and kills the process during the first checkpoint write
+(crash-safe rename must leave the previous checkpoint intact).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import random
+import re
+from typing import Dict, List, Optional
+
+__all__ = ["FaultPlan", "InjectedFault", "fault_point", "install_plan",
+           "clear_plan", "fault_plan_guard", "active_plan", "SITES"]
+
+logger = logging.getLogger("paddle_tpu.resilience")
+
+SITES = ("compile", "device_put", "step", "ckpt_write")
+
+# injected exceptions carry this mixin so retry/give-up handlers can tell a
+# scripted fault from a real infrastructure error (real errors keep their
+# pre-resilience behavior; injected ones must propagate for the chaos gate)
+class InjectedFault(Exception):
+    pass
+
+
+_BASES = {"RuntimeError": RuntimeError, "OSError": OSError,
+          "IOError": OSError, "TimeoutError": TimeoutError,
+          "ConnectionError": ConnectionError}
+_INJECTED_CLS: Dict[str, type] = {}
+
+
+def _injected_class(name: str) -> type:
+    if name not in _INJECTED_CLS:
+        base = _BASES[name]
+        _INJECTED_CLS[name] = type(f"Injected{base.__name__}",
+                                   (base, InjectedFault), {})
+    return _INJECTED_CLS[name]
+
+
+_RULE_RE = re.compile(r"^(?P<site>[a-z_]+):(?P<when>@?\d+|p(?:0?\.\d+|1(?:\.0+)?))"
+                      r":(?P<action>[A-Za-z_]+)$")
+
+
+@dataclasses.dataclass
+class _Rule:
+    site: str
+    action: str          # "kill" or an exception class name
+    count: Optional[int] = None   # fire on the first N hits
+    at: Optional[int] = None      # fire exactly on hit #K
+    prob: Optional[float] = None  # fire with probability p per hit
+
+    def fires(self, hit: int, rng: random.Random) -> bool:
+        if self.at is not None:
+            return hit == self.at
+        if self.count is not None:
+            return hit <= self.count
+        return rng.random() < (self.prob or 0.0)
+
+
+class FaultPlan:
+    """A parsed, seeded fault schedule. Hit counters are per-plan (and the
+    plan is per-process), so the same spec replays the same faults."""
+
+    def __init__(self, spec: str = "", seed: int = 0):
+        self.spec = spec or ""
+        self.seed = int(seed)
+        self.rules: Dict[str, List[_Rule]] = {}
+        self.hits: Dict[str, int] = {}
+        self.fired: List[tuple] = []   # (site, hit, action) audit trail
+        self._rng = random.Random(self.seed)
+        for part in filter(None, (p.strip() for p in self.spec.split(","))):
+            m = _RULE_RE.match(part)
+            if not m:
+                raise ValueError(
+                    f"FLAGS_fault_plan: cannot parse rule '{part}' — expected"
+                    f" site:N:action, site:@K:action or site:pX:action")
+            site, when, action = m.group("site", "when", "action")
+            if site not in SITES:
+                raise ValueError(f"FLAGS_fault_plan: unknown site '{site}' "
+                                 f"(known: {', '.join(SITES)})")
+            if action != "kill" and action not in _BASES:
+                raise ValueError(
+                    f"FLAGS_fault_plan: unknown action '{action}' (known: "
+                    f"kill, {', '.join(sorted(_BASES))})")
+            rule = _Rule(site=site, action=action)
+            if when.startswith("@"):
+                rule.at = int(when[1:])
+            elif when.startswith("p"):
+                rule.prob = float(when[1:])
+            else:
+                rule.count = int(when)
+            self.rules.setdefault(site, []).append(rule)
+
+    @property
+    def active(self) -> bool:
+        return bool(self.rules)
+
+    def hit(self, site: str) -> None:
+        """Record one pass through ``site``; perform the scheduled action if
+        a rule fires (raise an injected exception or kill the process)."""
+        rules = self.rules.get(site)
+        if not rules:
+            return
+        self.hits[site] = k = self.hits.get(site, 0) + 1
+        for rule in rules:
+            if not rule.fires(k, self._rng):
+                continue
+            self.fired.append((site, k, rule.action))
+            from .. import monitor as _monitor
+
+            if _monitor.enabled():
+                _monitor.counter(
+                    "resilience_faults_injected_total",
+                    "faults fired by the FLAGS_fault_plan schedule").labels(
+                    site=site, action=rule.action).inc()
+            if rule.action == "kill":
+                logger.warning("fault_plan: KILL at site '%s' (hit #%d)",
+                               site, k)
+                os._exit(137)
+            logger.warning("fault_plan: injecting %s at site '%s' (hit #%d)",
+                           rule.action, site, k)
+            raise _injected_class(rule.action)(
+                f"[resilience] injected {rule.action} at site '{site}' "
+                f"(hit #{k} of plan '{self.spec}')")
+
+
+# -- active-plan resolution -------------------------------------------------
+# explicit install_plan wins; otherwise FLAGS_fault_plan/FLAGS_fault_seed is
+# parsed lazily and cached on the (spec, seed) pair.
+
+_installed: Optional[FaultPlan] = None
+_flag_cache: Optional[tuple] = None   # (spec, seed, plan)
+
+
+def install_plan(plan: Optional[FaultPlan]) -> None:
+    global _installed
+    _installed = plan
+
+
+def clear_plan() -> None:
+    install_plan(None)
+
+
+def active_plan() -> Optional[FaultPlan]:
+    if _installed is not None:
+        return _installed if _installed.active else None
+    from ..flags import flag
+
+    spec = flag("fault_plan")
+    if not spec:
+        return None
+    seed = int(flag("fault_seed"))
+    global _flag_cache
+    if _flag_cache is None or _flag_cache[:2] != (spec, seed):
+        _flag_cache = (spec, seed, FaultPlan(spec, seed))
+    return _flag_cache[2]
+
+
+def fault_point(site: str) -> None:
+    """The injection probe. No active plan -> a dict lookup and return."""
+    plan = active_plan()
+    if plan is not None:
+        plan.hit(site)
+
+
+class fault_plan_guard:
+    """``with fault_plan_guard("compile:2:RuntimeError"):`` — install a plan
+    for a test body, restoring the previous plan (and flag cache) on exit."""
+
+    def __init__(self, spec_or_plan, seed: int = 0):
+        self._plan = (spec_or_plan if isinstance(spec_or_plan, FaultPlan)
+                      else FaultPlan(spec_or_plan, seed))
+
+    def __enter__(self) -> FaultPlan:
+        self._prev = _installed
+        install_plan(self._plan)
+        return self._plan
+
+    def __exit__(self, *exc):
+        install_plan(self._prev)
+        return False

@@ -1,0 +1,92 @@
+"""Graceful non-finite step degradation (``FLAGS_nan_inf_policy``).
+
+``FLAGS_check_nan_inf`` compiles per-op finite checks into every step
+(executor.make_step_fn); before this module its only possible outcome was a
+``FloatingPointError`` into the training loop — one bad batch (a single inf
+logit at scale is routine) killed a run that a human would have shrugged
+through. The policy ladder:
+
+* ``raise`` (default, the pre-resilience behavior): write the step's
+  outputs back (the inputs were donated) and raise with op provenance.
+* ``skip``: DROP the step — the scope is rolled back bit-exactly to its
+  pre-step values and training continues. Because the executor donates
+  parameter buffers (the liveness-proven in-place update from PR 2), the
+  old buffers would normally be consumed by XLA; under this policy the
+  executor donates fresh *copies* and keeps the originals as the rollback
+  image, so "pre-step values" means the exact same bits, not a re-read.
+  ``FLAGS_nan_inf_max_consecutive_skips`` consecutive trips escalate to
+  ``raise`` — persistent non-finiteness is a bug, not noise.
+* ``zero_grad``: same bit-exact rollback (for a stateless optimizer this
+  IS the zero-gradient update: params unchanged), but it never escalates —
+  the keep-training-through-noise mode. True masked-gradient semantics
+  would require re-running the fused step with zeroed grads; the
+  approximation is documented in docs/RESILIENCE.md.
+
+Each dropped step increments ``steps_skipped_nonfinite_total{path,policy}``.
+The consecutive-skip counter lives on the Executor (``_nonfinite_consec``)
+so independent executors escalate independently.
+"""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["policy", "rollback_active", "record_skip", "record_clean",
+           "POLICIES"]
+
+logger = logging.getLogger("paddle_tpu.resilience")
+
+POLICIES = ("raise", "skip", "zero_grad")
+
+
+def policy() -> str:
+    from ..flags import flag
+
+    p = str(flag("nan_inf_policy")).strip().lower()
+    if p not in POLICIES:
+        raise ValueError(
+            f"FLAGS_nan_inf_policy={p!r} — expected one of {POLICIES}")
+    return p
+
+
+def rollback_active() -> bool:
+    """True when the executor must preserve pre-step donated buffers (any
+    policy that can drop a step instead of raising)."""
+    from ..flags import flag
+
+    return flag("check_nan_inf") and policy() != "raise"
+
+
+def record_skip(path: str, label: str, exe=None) -> None:
+    """Account one dropped step AFTER the scope has been rolled back.
+    Raises ``FloatingPointError`` when ``skip`` escalation trips — the
+    scope is already restored, so even the escalation leaves a usable
+    session."""
+    from .. import monitor as _monitor
+    from ..flags import flag
+
+    pol = policy()
+    if _monitor.enabled():
+        _monitor.counter(
+            "steps_skipped_nonfinite_total",
+            "steps dropped (state rolled back) by FLAGS_nan_inf_policy").\
+            labels(path=path, policy=pol).inc()
+    if pol == "skip" and exe is not None:
+        exe._nonfinite_consec = getattr(exe, "_nonfinite_consec", 0) + 1
+        limit = int(flag("nan_inf_max_consecutive_skips"))
+        if limit and exe._nonfinite_consec >= limit:
+            raise FloatingPointError(
+                f"FLAGS_nan_inf_policy=skip escalated to raise: "
+                f"{exe._nonfinite_consec} consecutive non-finite steps "
+                f"(limit {limit}; last: non-finite value in {label}). "
+                f"Persistent non-finiteness is a model/data bug, not "
+                f"transient noise — state was rolled back to pre-step "
+                f"values.")
+    logger.warning(
+        "nan_inf_policy=%s: dropping step on path '%s' (non-finite value "
+        "in %s); state rolled back to pre-step values", pol, path, label)
+
+
+def record_clean(exe) -> None:
+    """A finite step resets the consecutive-skip escalation counter."""
+    if exe is not None:
+        exe._nonfinite_consec = 0

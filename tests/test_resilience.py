@@ -1,0 +1,575 @@
+"""paddle_tpu.resilience: crash-safe checkpoints (atomic publish + manifest
+verification + torn-checkpoint fallback), deterministic fault injection,
+retry/backoff at the transient executor sites, and FLAGS_nan_inf_policy
+step degradation. The real-kill end-to-end lives in tools/chaos_check.py
+(CI); these tests cover the same machinery in-process."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.unique_name as un
+from paddle_tpu import monitor, resilience
+from paddle_tpu.resilience import (CheckpointCorruptError, FaultPlan,
+                                   RetryExhaustedError, call_with_retry,
+                                   fault_plan_guard)
+
+
+@pytest.fixture
+def flags_guard():
+    """Snapshot/restore set_flags overrides so a failing test can't leak
+    resilience flags into the rest of the suite."""
+    from paddle_tpu import flags as F
+
+    saved = dict(F._overrides)
+    yield fluid.set_flags
+    F._overrides.clear()
+    F._overrides.update(saved)
+
+
+def _build_regression():
+    x = fluid.layers.data("x", shape=[4], dtype="float32")
+    y = fluid.layers.data("y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _feed(batch=8, nan=False):
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, 4).astype(np.float32)
+    if nan:
+        x = np.full_like(x, np.nan)
+    return {"x": x, "y": rng.rand(batch, 1).astype(np.float32)}
+
+
+def _scope_image(scope):
+    return {n: np.asarray(scope.find_var(n)).copy() for n in scope.vars}
+
+
+def _scopes_equal(a, b):
+    return set(a) == set(b) and all(
+        np.array_equal(a[n], b[n], equal_nan=True) for n in a)
+
+
+class _Session:
+    """One built regression program + executor + initialized scope."""
+
+    def __init__(self):
+        self.guard = un.guard()
+        self.guard.__enter__()
+        self.main, self.startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(self.main, self.startup):
+            self.loss = _build_regression()
+        self.exe = fluid.Executor(fluid.CPUPlace())
+        self.scope = fluid.Scope()
+        with fluid.scope_guard(self.scope):
+            self.exe.run(self.startup)
+        self.guard.__exit__(None, None, None)
+
+    def run(self, feed, **kw):
+        with fluid.scope_guard(self.scope):
+            return self.exe.run(self.main, feed=feed,
+                                fetch_list=[self.loss], **kw)
+
+    def save(self, dirname, meta=None):
+        with fluid.scope_guard(self.scope):
+            fluid.io.save_checkpoint(self.exe, dirname, self.main,
+                                     scope=self.scope, meta=meta or {})
+
+    def load(self, dirname, **kw):
+        with fluid.scope_guard(self.scope):
+            return fluid.io.load_checkpoint(self.exe, dirname, self.main,
+                                            scope=self.scope, **kw)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_manifest_and_verify_roundtrip(tmp_path):
+    s = _Session()
+    ck = str(tmp_path / "checkpoint_0")
+    s.run(_feed())
+    s.save(ck, meta={"step": 1})
+    manifest = resilience.verify_checkpoint(ck)
+    assert manifest["format_version"] == resilience.FORMAT_VERSION
+    assert set(manifest["files"]) == {"ckpt.npz", "meta.json"}
+    assert all("sha256" in f and "bytes" in f
+               for f in manifest["files"].values())
+    assert manifest["framework_version"] == fluid.__version__
+    assert s.load(ck)["step"] == 1
+
+
+def test_tampered_blob_is_detected_not_loaded(tmp_path):
+    s = _Session()
+    ck = str(tmp_path / "checkpoint_0")
+    s.save(ck, meta={"step": 3})
+    blob = os.path.join(ck, "ckpt.npz")
+    raw = bytearray(open(blob, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    with open(blob, "wb") as f:
+        f.write(raw)
+    before = _scope_image(s.scope)
+    with pytest.raises(CheckpointCorruptError) as ei:
+        s.load(ck)
+    assert ei.value.code == "PT603"
+    # verification failed BEFORE loading: not a byte reached the scope
+    assert _scopes_equal(before, _scope_image(s.scope))
+
+
+def test_corruption_codes_name_what_failed(tmp_path):
+    s = _Session()
+    ck = str(tmp_path / "checkpoint_0")
+    s.save(ck)
+    # missing file listed in the manifest
+    os.remove(os.path.join(ck, "meta.json"))
+    with pytest.raises(CheckpointCorruptError) as ei:
+        resilience.verify_checkpoint(ck)
+    assert ei.value.code == "PT602" and "meta.json" in str(ei.value)
+    # unreadable manifest
+    with open(os.path.join(ck, "manifest.json"), "w") as f:
+        f.write("{ not json")
+    with pytest.raises(CheckpointCorruptError) as ei:
+        resilience.verify_checkpoint(ck)
+    assert ei.value.code == "PT601"
+    # no manifest at all (torn pre-manifest write)
+    os.remove(os.path.join(ck, "manifest.json"))
+    with pytest.raises(CheckpointCorruptError) as ei:
+        resilience.verify_checkpoint(ck)
+    assert ei.value.code == "PT600"
+    # future format version
+    s.save(ck)
+    mpath = os.path.join(ck, "manifest.json")
+    m = json.load(open(mpath))
+    m["format_version"] = resilience.FORMAT_VERSION + 1
+    with open(mpath, "w") as f:
+        json.dump(m, f)
+    with pytest.raises(CheckpointCorruptError) as ei:
+        resilience.verify_checkpoint(ck)
+    assert ei.value.code == "PT604"
+
+
+def test_failed_save_preserves_previous_checkpoint(tmp_path):
+    """An injected fault mid-write (the exception flavour of the chaos
+    kill) must leave the previously published checkpoint intact and leak
+    no temp dir."""
+    s = _Session()
+    ck = str(tmp_path / "checkpoint_0")
+    s.save(ck, meta={"step": 1})
+    with fault_plan_guard("ckpt_write:1:RuntimeError"):
+        with pytest.raises(RuntimeError):
+            s.save(ck, meta={"step": 2})
+    resilience.verify_checkpoint(ck)
+    assert s.load(ck)["step"] == 1
+    assert [p for p in os.listdir(str(tmp_path)) if ".tmp." in p] == []
+
+
+def test_save_checkpoint_over_nonempty_dir_replaces_atomically(tmp_path):
+    s = _Session()
+    ck = str(tmp_path / "checkpoint_0")
+    s.save(ck, meta={"step": 1})
+    s.run(_feed())
+    s.save(ck, meta={"step": 2})
+    resilience.verify_checkpoint(ck)
+    assert s.load(ck)["step"] == 2
+    assert [p for p in os.listdir(str(tmp_path)) if "replaced" in p] == []
+
+
+def test_dirname_exists_as_file_raises_clear_error(tmp_path):
+    s = _Session()
+    as_file = tmp_path / "not_a_dir"
+    as_file.write_text("occupied")
+    with pytest.raises(ValueError, match="exists as a FILE"):
+        s.save(str(as_file))
+    with pytest.raises(ValueError, match="exists as a FILE"):
+        with fluid.scope_guard(s.scope):
+            fluid.io.save_persistables(s.exe, str(as_file), s.main,
+                                       scope=s.scope)
+    with pytest.raises(ValueError, match="exists as a FILE"):
+        with fluid.scope_guard(s.scope):
+            fluid.io.save_inference_model(str(as_file), ["x"], [s.loss],
+                                          s.exe, main_program=s.main,
+                                          scope=s.scope)
+
+
+# ---------------------------------------------------------------------------
+# Trainer recovery walk
+# ---------------------------------------------------------------------------
+
+def _train_func():
+    x = fluid.layers.data("x", shape=[4], dtype="float32")
+    y = fluid.layers.data("y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, 1, name="fit")
+    return fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+
+
+def _make_trainer(ckpt_dir, max_num=3):
+    cfg = fluid.contrib.CheckpointConfig(str(ckpt_dir),
+                                         max_num_checkpoints=max_num)
+    with un.guard():
+        return fluid.contrib.Trainer(_train_func,
+                                     lambda: fluid.optimizer.SGD(0.05),
+                                     checkpoint_config=cfg)
+
+
+def test_trainer_tolerates_empty_and_garbage_ckpt_dir(tmp_path):
+    d = tmp_path / "ckpts"
+    # missing dir
+    t = _make_trainer(d)
+    assert t._step == 0
+    # garbage entries: stray file, non-numeric serial, torn temp dir
+    d.mkdir(exist_ok=True)
+    (d / "README").write_text("junk")
+    (d / "checkpoint_notanumber").mkdir()
+    (d / ".checkpoint_7.tmp.123").mkdir()
+    (d / "checkpoint_3_old").mkdir()
+    t2 = _make_trainer(d)
+    assert t2._step == 0 and t2._serials() == []
+
+
+def test_trainer_falls_back_past_torn_checkpoint(tmp_path):
+    d = tmp_path / "ckpts"
+    t = _make_trainer(d)
+    t._step = 7
+    t._save_checkpoint()              # checkpoint_0, verified
+    good = {n: np.asarray(t.scope.find_var(n)).copy()
+            for n in t.scope.vars}
+    # newest serial is torn: blobs but no integrity manifest (what a kill
+    # between blob write and manifest/rename leaves if an old non-atomic
+    # writer had published it)
+    torn = d / "checkpoint_1"
+    torn.mkdir()
+    (torn / "ckpt.npz").write_bytes(b"\x00\x01garbage")
+    (torn / "meta.json").write_text('{"step": 999}')
+    before = monitor.metric_value("trainer_ckpt_fallback_total",
+                                  default=0.0, code="PT600")
+    t2 = _make_trainer(d)
+    assert t2._step == 7, "must resume from checkpoint_0, not the torn 1"
+    after = monitor.metric_value("trainer_ckpt_fallback_total",
+                                 default=0.0, code="PT600")
+    assert after == before + 1
+    for n, v in good.items():
+        got = t2.scope.find_var(n)
+        if got is not None:
+            np.testing.assert_array_equal(np.asarray(got), v)
+
+
+def test_recovery_falls_back_to_legacy_checkpoint_when_nothing_verifies(
+        tmp_path):
+    """Upgrade path: a dir holding only pre-resilience checkpoints
+    (manifest without the 'files' integrity section) must still resume —
+    unverified, loudly — instead of silently restarting at step 0. A
+    verified serial always wins over a NEWER legacy-shaped one (that one
+    is indistinguishable from a torn write)."""
+    d = tmp_path / "ckpts"
+    t = _make_trainer(d)
+    t._step = 11
+    t._save_checkpoint()              # checkpoint_0
+    # strip the integrity section: exactly what the old writer produced
+    mpath = d / "checkpoint_0" / "manifest.json"
+    m = json.load(open(mpath))
+    del m["files"]
+    with open(mpath, "w") as f:
+        json.dump(m, f)
+    t2 = _make_trainer(d)
+    assert t2._step == 11, "legacy checkpoint must load as last resort"
+    # but once a verified serial exists, a newer legacy dir is skipped
+    t2._save_checkpoint()             # checkpoint_1, verified, step 11
+    torn = d / "checkpoint_5"
+    torn.mkdir()
+    (torn / "ckpt.npz").write_bytes(b"junk")
+    t3 = _make_trainer(d)
+    assert t3._step == 11
+    assert t3._load_latest() == 1
+
+
+def test_shape_mismatch_load_leaves_scope_untouched(tmp_path):
+    """A checkpoint that verifies but cannot load (program changed shape)
+    must not half-mutate the scope: validation happens before the first
+    set_var."""
+    s = _Session()
+    ck = str(tmp_path / "checkpoint_0")
+    s.run(_feed())
+    s.save(ck)
+    # tamper the recorded shape of ONE var inside the npz-declared program
+    # contract by rebuilding a program with a different fc width
+    with un.guard(), fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[2], dtype="float32")
+        pred = fluid.layers.fc(x, 2)   # width 2, checkpoint has width 1:
+        loss2 = fluid.layers.mean(    # same var names, different shapes
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss2)
+        main2 = fluid.default_main_program()
+        startup2 = fluid.default_startup_program()
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        s.exe.run(startup2)
+        before = _scope_image(s2)
+        with pytest.raises(RuntimeError, match="shape mismatch"):
+            fluid.io.load_checkpoint(s.exe, ck, main2, scope=s2)
+    assert _scopes_equal(before, _scope_image(s2))
+
+
+def test_trainer_rotation_keep_all_when_max_is_zero(tmp_path):
+    """max_num_checkpoints<=0 keeps full history (the pre-resilience [:-0]
+    slice semantics, preserved on purpose)."""
+    t = _make_trainer(tmp_path / "ckpts", max_num=0)
+    for step in (1, 2, 3):
+        t._step = step
+        t._save_checkpoint()
+    assert t._serials() == [0, 1, 2]
+
+
+def test_trainer_rotation_never_deletes_what_it_just_wrote(tmp_path):
+    t = _make_trainer(tmp_path / "ckpts", max_num=1)
+    for step in (1, 2, 3):
+        t._step = step
+        t._save_checkpoint()
+        serials = t._serials()
+        assert len(serials) == 1, serials
+        assert t._load_latest() == serials[-1]
+        assert t._step == step
+
+
+# ---------------------------------------------------------------------------
+# fault plans + retry
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_parsing_and_determinism():
+    plan = FaultPlan("compile:2:RuntimeError,ckpt_write:@3:kill", seed=7)
+    assert set(plan.rules) == {"compile", "ckpt_write"}
+    with pytest.raises(ValueError, match="unknown site"):
+        FaultPlan("teleport:1:RuntimeError")
+    with pytest.raises(ValueError, match="unknown action"):
+        FaultPlan("compile:1:SegFault")
+    with pytest.raises(ValueError, match="cannot parse"):
+        FaultPlan("compile:whenever:RuntimeError")
+    # probabilistic rules replay identically for the same seed
+    fires = []
+    for _ in range(2):
+        p = FaultPlan("step:p0.5:RuntimeError", seed=13)
+        seq = []
+        for _ in range(20):
+            try:
+                p.hit("step")
+                seq.append(False)
+            except RuntimeError:
+                seq.append(True)
+        fires.append(seq)
+    assert fires[0] == fires[1] and any(fires[0]) and not all(fires[0])
+
+
+def test_retry_transient_then_succeed(flags_guard):
+    flags_guard({"FLAGS_retry_base_delay": 0.0})
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TimeoutError("transient")
+        return "done"
+
+    before = monitor.metric_value("resilience_retries_total", default=0.0,
+                                  site="device_put")
+    assert call_with_retry("device_put", flaky) == "done"
+    after = monitor.metric_value("resilience_retries_total", default=0.0,
+                                 site="device_put")
+    assert calls["n"] == 3 and after == before + 2
+
+
+def test_retry_exhausted_raises_with_cause(flags_guard):
+    flags_guard({"FLAGS_retry_base_delay": 0.0,
+                 "FLAGS_retry_max_attempts": 2})
+
+    def always():
+        raise ConnectionError("still down")
+
+    before = monitor.metric_value("resilience_giveups_total", default=0.0,
+                                  site="compile")
+    with pytest.raises(RetryExhaustedError) as ei:
+        call_with_retry("compile", always)
+    assert ei.value.attempts == 2
+    assert isinstance(ei.value.last_error, ConnectionError)
+    after = monitor.metric_value("resilience_giveups_total", default=0.0,
+                                 site="compile")
+    assert after == before + 1
+
+
+def test_nontransient_errors_never_retry(flags_guard):
+    flags_guard({"FLAGS_retry_base_delay": 0.0})
+    calls = {"n": 0}
+
+    def shape_bug():
+        calls["n"] += 1
+        raise ValueError("shape mismatch — a bug, not weather")
+
+    with pytest.raises(ValueError):
+        call_with_retry("compile", shape_bug)
+    assert calls["n"] == 1
+    # the PT* verifier error is a ValueError subclass: also never retried
+    from paddle_tpu.analysis import ProgramVerificationError
+
+    assert not resilience.is_transient(ProgramVerificationError([]))
+    assert not resilience.is_transient(FloatingPointError("nan"))
+    assert resilience.is_transient(RuntimeError("xla transport flake"))
+    # a RuntimeError wrapper chained onto a deterministic bug (lowering's
+    # "while lowering op ..." pattern) must NOT retry
+    try:
+        try:
+            raise AttributeError("no such attr")
+        except AttributeError as cause:
+            raise RuntimeError("while lowering op 'x'") from cause
+    except RuntimeError as wrapped:
+        assert not resilience.is_transient(wrapped)
+
+
+def test_executor_compile_site_retries_injected_faults(flags_guard):
+    flags_guard({"FLAGS_retry_base_delay": 0.0})
+    before = monitor.metric_value("resilience_retries_total", default=0.0,
+                                  site="compile")
+    with fault_plan_guard("compile:2:RuntimeError"):
+        s = _Session()
+        (lv,) = s.run(_feed())
+    assert np.isfinite(np.asarray(lv)).all()
+    after = monitor.metric_value("resilience_retries_total", default=0.0,
+                                 site="compile")
+    assert after == before + 2
+
+
+def test_executor_device_put_site_retries(flags_guard):
+    flags_guard({"FLAGS_retry_base_delay": 0.0})
+    s = _Session()
+    before = monitor.metric_value("resilience_retries_total", default=0.0,
+                                  site="device_put")
+    with fault_plan_guard("device_put:1:RuntimeError"):
+        s.run(_feed())
+    after = monitor.metric_value("resilience_retries_total", default=0.0,
+                                 site="device_put")
+    assert after == before + 1
+
+
+def test_step_site_fault_leaves_scope_usable(flags_guard):
+    s = _Session()
+    s.run(_feed())
+    before = _scope_image(s.scope)
+    with fault_plan_guard("step:1:RuntimeError"):
+        with pytest.raises(RuntimeError, match="injected"):
+            s.run(_feed())
+    # probe fires before donation: nothing was consumed or half-written
+    assert _scopes_equal(before, _scope_image(s.scope))
+    s.run(_feed())   # and the session still trains
+
+
+# ---------------------------------------------------------------------------
+# FLAGS_nan_inf_policy
+# ---------------------------------------------------------------------------
+
+def _nan_flags(flags_guard, policy, limit=5):
+    flags_guard({"FLAGS_check_nan_inf": 1,
+                 "FLAGS_nan_inf_policy": policy,
+                 "FLAGS_nan_inf_max_consecutive_skips": limit})
+
+
+def test_nan_policy_skip_is_bit_exact_on_run_path(flags_guard):
+    s = _Session()
+    s.run(_feed())
+    _nan_flags(flags_guard, "skip")
+    before = _scope_image(s.scope)
+    skipped0 = monitor.metric_value("steps_skipped_nonfinite_total",
+                                    default=0.0, path="run", policy="skip")
+    out = s.run(_feed(nan=True))     # dropped, not raised
+    assert not np.isfinite(np.asarray(out[0])).all()
+    assert _scopes_equal(before, _scope_image(s.scope))
+    assert monitor.metric_value("steps_skipped_nonfinite_total",
+                                default=0.0, path="run",
+                                policy="skip") == skipped0 + 1
+    # a clean step afterwards still updates params
+    s.run(_feed())
+    assert not _scopes_equal(before, _scope_image(s.scope))
+
+
+def test_nan_policy_skip_is_bit_exact_on_chained_path(flags_guard):
+    s = _Session()
+    s.run(_feed())
+    _nan_flags(flags_guard, "skip")
+    before = _scope_image(s.scope)
+    with fluid.scope_guard(s.scope):
+        stacked = s.exe.run_chained(s.main, feed=_feed(nan=True),
+                                    fetch_list=[s.loss], steps=3)
+    assert np.asarray(stacked[0]).shape[0] == 3
+    assert _scopes_equal(before, _scope_image(s.scope))
+    assert monitor.metric_value("steps_skipped_nonfinite_total",
+                                default=0.0, path="chained",
+                                policy="skip") >= 1
+
+
+def test_nan_policy_skip_is_bit_exact_on_parallel_path(flags_guard):
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            loss = _build_regression()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        prog = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(prog, feed=_feed(), fetch_list=[loss])
+            _nan_flags(flags_guard, "skip")
+            before = _scope_image(scope)
+            exe.run(prog, feed=_feed(nan=True), fetch_list=[loss])
+            assert _scopes_equal(before, _scope_image(scope))
+            assert monitor.metric_value(
+                "steps_skipped_nonfinite_total", default=0.0,
+                path="parallel", policy="skip") >= 1
+            # clean parallel step still trains
+            exe.run(prog, feed=_feed(), fetch_list=[loss])
+            assert not _scopes_equal(before, _scope_image(scope))
+
+
+def test_nan_policy_raise_is_default_behavior(flags_guard):
+    s = _Session()
+    _nan_flags(flags_guard, "raise")
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        s.run(_feed(nan=True))
+
+
+def test_nan_skip_escalates_after_consecutive_trips(flags_guard):
+    s = _Session()
+    s.run(_feed())
+    _nan_flags(flags_guard, "skip", limit=2)
+    before = _scope_image(s.scope)
+    s.run(_feed(nan=True))           # skip #1
+    with pytest.raises(FloatingPointError, match="escalated"):
+        s.run(_feed(nan=True))       # skip #2 == limit -> raise
+    # even the escalation left the rolled-back state
+    assert _scopes_equal(before, _scope_image(s.scope))
+    # a clean step resets the consecutive counter
+    s.run(_feed())
+    s.run(_feed(nan=True))           # counter restarted: skip, no raise
+
+
+def test_nan_zero_grad_never_escalates(flags_guard):
+    s = _Session()
+    s.run(_feed())
+    _nan_flags(flags_guard, "zero_grad", limit=1)
+    before = _scope_image(s.scope)
+    for _ in range(3):
+        s.run(_feed(nan=True))
+    assert _scopes_equal(before, _scope_image(s.scope))
+    assert monitor.metric_value("steps_skipped_nonfinite_total",
+                                default=0.0, path="run",
+                                policy="zero_grad") >= 3
+
+
+def test_unknown_nan_policy_rejected(flags_guard):
+    s = _Session()
+    flags_guard({"FLAGS_check_nan_inf": 1,
+                 "FLAGS_nan_inf_policy": "shrug"})
+    with pytest.raises(ValueError, match="nan_inf_policy"):
+        s.run(_feed())

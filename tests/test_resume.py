@@ -89,6 +89,82 @@ def test_dataloader_state_dict_resumes_mid_epoch():
     np.testing.assert_allclose(part, full, rtol=1e-6, atol=1e-7)
 
 
+def test_resume_walks_past_torn_checkpoint(tmp_path):
+    """Kill-mid-save resume (resilience): the newest serial is torn (blobs
+    on disk, no integrity manifest — what a non-atomic writer's death
+    leaves); recovery must report it and land on the last VERIFIED serial,
+    restoring both params and the data-loader position recorded in meta."""
+    import os
+
+    from paddle_tpu import resilience
+
+    with un.guard(), fluid.program_guard(fluid.Program(), fluid.Program()):
+        x, y, loss = _build()
+        main, startup = (fluid.default_main_program(),
+                         fluid.default_startup_program())
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            loader = _loader(x, y)
+            it = iter(loader)
+            for _ in range(4):
+                exe.run(main, feed=next(it), fetch_list=[loss])
+            fluid.io.save_checkpoint(
+                exe, str(tmp_path / "checkpoint_4"), main, scope=scope,
+                meta={"step": 4, "reader": loader.state_dict()})
+            good = {n: np.asarray(scope.find_var(n)).copy()
+                    for n in scope.vars}
+        # the torn serial: valid-looking blobs, no integrity section
+        torn = tmp_path / "checkpoint_9"
+        torn.mkdir()
+        (torn / "ckpt.npz").write_bytes(b"not really an npz")
+        (torn / "meta.json").write_text('{"step": 9}')
+        s2 = fluid.Scope()
+        with fluid.scope_guard(s2):
+            exe.run(startup)
+            meta, serial, skipped = resilience.load_latest_checkpoint(
+                exe, str(tmp_path), main_program=main, scope=s2)
+        assert serial == 4 and meta["step"] == 4
+        assert [s["serial"] for s in skipped] == [9]
+        assert str(skipped[0]["code"]).startswith("PT6")
+        for n, v in good.items():
+            np.testing.assert_array_equal(np.asarray(s2.find_var(n)), v)
+        loader2 = _loader(x, y)
+        loader2.set_state_dict(meta["reader"])
+        assert sum(1 for _ in loader2) == 4  # 8 per epoch - 4 consumed
+        assert not os.path.exists(str(tmp_path / "checkpoint_9" /
+                                      "manifest.json"))
+
+
+def test_tampered_checkpoint_refused_on_resume(tmp_path):
+    """A bit-flip in the blob after a clean save must be detected by the
+    manifest BEFORE anything loads (PT603), and verify=False documents the
+    legacy escape hatch."""
+    from paddle_tpu import resilience
+
+    with un.guard(), fluid.program_guard(fluid.Program(), fluid.Program()):
+        x, y, loss = _build()
+        main, startup = (fluid.default_main_program(),
+                         fluid.default_startup_program())
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            fluid.io.save_checkpoint(exe, str(tmp_path), main, scope=scope,
+                                     meta={"step": 2})
+        blob = tmp_path / "ckpt.npz"
+        raw = bytearray(blob.read_bytes())
+        raw[len(raw) // 3] ^= 0x5A
+        blob.write_bytes(bytes(raw))
+        s2 = fluid.Scope()
+        with fluid.scope_guard(s2):
+            exe.run(startup)
+            with pytest.raises(resilience.CheckpointCorruptError) as ei:
+                fluid.io.load_checkpoint(exe, str(tmp_path), main, scope=s2)
+        assert ei.value.code == "PT603"
+
+
 def test_checkpoint_roundtrip_with_loader_state(tmp_path):
     with un.guard(), fluid.program_guard(fluid.Program(), fluid.Program()):
         x, y, loss = _build()

@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""Chaos CI gate for ``paddle_tpu.resilience`` (sibling of
+tools/metrics_report.py, docs/RESILIENCE.md for the failure model).
+
+Proves, end to end with REAL process kills, that restart-after-failure is a
+working path and not an accident:
+
+1. **baseline** — a short deterministic training loop runs uninterrupted in
+   a subprocess; final step / loss / param digest are recorded.
+2. **kill mid-checkpoint** — the same loop runs under
+   ``FLAGS_fault_plan="ckpt_write:@2:kill"``: the process is killed
+   (``os._exit(137)``) inside the SECOND checkpoint write, after the blobs
+   hit disk but before manifest + atomic rename. The gate asserts the live
+   checkpoint dir holds only verified checkpoints plus a torn TEMP dir —
+   the crash-safe write can not tear a published checkpoint.
+3. **torn promotion** — the torn temp dir is renamed to ``checkpoint_10``,
+   simulating a pre-resilience (non-atomic) writer dying mid-write.
+4. **resume under compile faults** — the worker restarts in the same dir
+   under ``FLAGS_fault_plan="compile:2:RuntimeError"``. It must: skip the
+   torn checkpoint_10 with a PT6xx diagnostic (reported, never loaded),
+   resume from the last VERIFIED checkpoint, absorb both transient compile
+   faults via retry/backoff, and finish with the exact final loss + param
+   digest of the uninterrupted baseline.
+
+Usage:
+  python tools/chaos_check.py                 # run + print the phase table
+  python tools/chaos_check.py --check --json ci_chaos_report.json
+      CI gate: exit 1 unless every phase assertion holds.
+  python tools/chaos_check.py --check --negative-control
+      Kill + torn-promotion as above, but the resume runs with retries
+      DISABLED (FLAGS_retry_max_attempts=1) under a persistent compile
+      fault plan: resume must fail and the gate must FAIL (non-zero exit)
+      — CI runs this once to prove the gate actually trips.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+TOTAL_STEPS = 30
+CKPT_EVERY = 5
+KILL_SERIAL = 2 * CKPT_EVERY       # the save the kill interrupts
+RESUME_SERIAL = KILL_SERIAL - CKPT_EVERY  # last verified checkpoint
+
+
+# ---------------------------------------------------------------------------
+# worker: one deterministic training run (invoked as a subprocess so a
+# fault-plan `kill` takes out a real process, not the gate)
+# ---------------------------------------------------------------------------
+
+def _batch(step: int):
+    import numpy as np
+
+    rng = np.random.RandomState(1234 + step)
+    w = np.arange(1, 5, dtype=np.float32).reshape(4, 1) / 4.0
+    x = rng.rand(8, 4).astype(np.float32)
+    return {"x": x, "y": (x @ w).astype(np.float32)}
+
+
+def run_worker(args) -> int:
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor, resilience
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        main = fluid.default_main_program()
+        startup = fluid.default_startup_program()
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            meta, serial, skipped = resilience.load_latest_checkpoint(
+                exe, args.ckpt_dir, main_program=main, scope=scope)
+            start = int(meta.get("step", 0)) if meta else 0
+            final_loss = None
+            for step in range(start, args.total_steps):
+                (lv,) = exe.run(main, feed=_batch(step), fetch_list=[loss])
+                final_loss = float(np.asarray(lv).reshape(-1)[0])
+                done = step + 1
+                if done % args.ckpt_every == 0:
+                    fluid.io.save_checkpoint(
+                        exe, os.path.join(args.ckpt_dir,
+                                          f"checkpoint_{done}"),
+                        main, scope=scope, meta={"step": done})
+            import hashlib
+
+            digest = hashlib.sha256()
+            for name in sorted(scope.vars):
+                digest.update(name.encode())
+                digest.update(np.ascontiguousarray(
+                    np.asarray(scope.find_var(name))).tobytes())
+    result = {
+        "start_step": start,
+        "resumed_from_serial": serial,
+        "skipped_checkpoints": skipped,
+        "final_step": args.total_steps,
+        "final_loss": final_loss,
+        "params_sha256": digest.hexdigest(),
+        "retries": monitor.metric_value("resilience_retries_total",
+                                        default=0.0, site="compile"),
+        "giveups": monitor.metric_value("resilience_giveups_total",
+                                        default=0.0, site="compile"),
+        "fallbacks": len(skipped),
+    }
+    with open(args.result, "w") as f:
+        json.dump(result, f, indent=1)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: phase orchestration + gate
+# ---------------------------------------------------------------------------
+
+def _spawn(ckpt_dir: str, result: str, extra_env: dict) -> int:
+    env = dict(os.environ)
+    # resilience/monitor flags leaking in from the caller's environment
+    # would corrupt the phase semantics (FLAGS_monitor=0 would zero the
+    # retry counters the gate asserts on) — each phase sets exactly the
+    # flags it needs
+    for leak in ("FLAGS_fault_plan", "FLAGS_fault_seed",
+                 "FLAGS_retry_max_attempts", "FLAGS_retry_timeout",
+                 "FLAGS_nan_inf_policy", "FLAGS_monitor"):
+        env.pop(leak, None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["FLAGS_retry_base_delay"] = "0.01"
+    env.update(extra_env)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--ckpt-dir", ckpt_dir, "--result", result,
+         "--total-steps", str(TOTAL_STEPS),
+         "--ckpt-every", str(CKPT_EVERY)],
+        env=env, cwd=REPO)
+    return proc.returncode
+
+
+def _load(path: str):
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def run_gate(args) -> int:
+    from paddle_tpu import resilience
+
+    work = os.path.abspath(args.workdir)
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work, exist_ok=True)
+    checks = []          # (name, ok, detail)
+    report = {"mode": "negative-control" if args.negative_control
+              else "chaos", "phases": {}}
+
+    def check(name, ok, detail=""):
+        checks.append((name, bool(ok), detail))
+        print(f"  [{'ok' if ok else 'MISS'}] {name}"
+              + (f": {detail}" if detail else ""))
+
+    # -- phase 1: uninterrupted baseline (skipped in negative control:
+    # the control only needs to prove the gate trips on a failed resume)
+    base = None
+    if not args.negative_control:
+        print("== phase 1: uninterrupted baseline")
+        rc = _spawn(os.path.join(work, "baseline_ckpts"),
+                    os.path.join(work, "baseline.json"), {})
+        base = _load(os.path.join(work, "baseline.json"))
+        check("baseline_clean", rc == 0 and base
+              and base["final_step"] == TOTAL_STEPS,
+              f"rc={rc}")
+        report["phases"]["baseline"] = base
+
+    # -- phase 2: kill during the 2nd checkpoint write
+    print(f"== phase 2: kill inside checkpoint_{KILL_SERIAL} write "
+          f"(FLAGS_fault_plan=ckpt_write:@2:kill)")
+    ckpt_dir = os.path.join(work, "chaos_ckpts")
+    rc = _spawn(ckpt_dir, os.path.join(work, "victim.json"),
+                {"FLAGS_fault_plan": "ckpt_write:@2:kill"})
+    check("victim_killed", rc == 137, f"rc={rc} (137 = injected kill)")
+    serials = [s for s, _ in resilience.iter_serials(ckpt_dir)]
+    check("kill_left_only_verified_checkpoints",
+          serials == [RESUME_SERIAL] and _verifies(
+              resilience, ckpt_dir, RESUME_SERIAL),
+          f"published serials after kill: {serials}")
+    torn_tmp = sorted(glob.glob(
+        os.path.join(ckpt_dir, f".checkpoint_{KILL_SERIAL}.tmp.*")))
+    check("torn_write_is_temp_dir", len(torn_tmp) == 1,
+          f"temp dirs: {[os.path.basename(t) for t in torn_tmp]}")
+    report["phases"]["kill"] = {"rc": rc, "serials_after_kill": serials,
+                                "torn_tmp": torn_tmp}
+
+    # -- phase 3: promote the torn temp dir to a live serial (simulates a
+    # pre-resilience non-atomic writer dying mid-write)
+    if torn_tmp:
+        os.rename(torn_tmp[0],
+                  os.path.join(ckpt_dir, f"checkpoint_{KILL_SERIAL}"))
+        print(f"== phase 3: torn temp promoted to checkpoint_{KILL_SERIAL}")
+
+    # -- phase 4: resume
+    if args.negative_control:
+        print("== phase 4 (negative control): resume with retries DISABLED "
+              "under a persistent compile fault")
+        extra = {"FLAGS_fault_plan": "compile:99:RuntimeError",
+                 "FLAGS_retry_max_attempts": "1"}
+    else:
+        print("== phase 4: resume under 2 transient compile faults "
+              "(FLAGS_fault_plan=compile:2:RuntimeError)")
+        extra = {"FLAGS_fault_plan": "compile:2:RuntimeError"}
+    rc = _spawn(ckpt_dir, os.path.join(work, "resume.json"), extra)
+    res = _load(os.path.join(work, "resume.json"))
+    report["phases"]["resume"] = {"rc": rc, "result": res}
+    check("resume_completed", rc == 0 and res
+          and res["final_step"] == TOTAL_STEPS, f"rc={rc}")
+    if res:
+        check("resumed_from_last_verified",
+              res["resumed_from_serial"] == RESUME_SERIAL,
+              f"resumed from {res['resumed_from_serial']}, want "
+              f"{RESUME_SERIAL}")
+        torn_reports = [s for s in res["skipped_checkpoints"]
+                        if s["serial"] == KILL_SERIAL]
+        check("torn_checkpoint_reported_not_loaded",
+              len(torn_reports) == 1 and str(
+                  torn_reports[0]["code"]).startswith("PT6"),
+              f"skipped: {res['skipped_checkpoints']}")
+        if not args.negative_control:
+            check("transient_faults_absorbed",
+                  res["retries"] == 2 and res["giveups"] == 0,
+                  f"retries={res['retries']} giveups={res['giveups']}")
+    if base and res:
+        dl = abs(res["final_loss"] - base["final_loss"])
+        check("final_loss_matches_uninterrupted_run", dl < 1e-6,
+              f"|Δloss|={dl:.3g} at step {TOTAL_STEPS}")
+        check("final_params_bit_identical",
+              res["params_sha256"] == base["params_sha256"])
+
+    ok = all(c[1] for c in checks)
+    report["checks"] = [{"name": n, "ok": o, "detail": d}
+                        for n, o, d in checks]
+    report["status"] = "ok" if ok else "fail"
+    print(f"chaos gate: {len([c for c in checks if c[1]])}/{len(checks)} "
+          f"checks -> {'ok' if ok else 'FAIL'}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+        print(f"chaos artifact written to {args.json}")
+    if not args.keep_workdir and ok:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0 if (not args.check or ok) else 1
+
+
+def _verifies(resilience, ckpt_dir: str, serial: int) -> bool:
+    try:
+        resilience.verify_checkpoint(
+            os.path.join(ckpt_dir, f"checkpoint_{serial}"))
+        return True
+    except Exception:
+        return False
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 unless every phase assertion holds")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the chaos report artifact as JSON")
+    ap.add_argument("--negative-control", action="store_true",
+                    help="resume with retries disabled — the gate must "
+                         "FAIL (proves the tripwire trips)")
+    ap.add_argument("--workdir", default=os.path.join(
+        REPO, ".chaos_check"), help="scratch dir for checkpoints/results")
+    ap.add_argument("--keep-workdir", action="store_true")
+    # internal worker protocol
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--ckpt-dir", help=argparse.SUPPRESS)
+    ap.add_argument("--result", help=argparse.SUPPRESS)
+    ap.add_argument("--total-steps", type=int, default=TOTAL_STEPS,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--ckpt-every", type=int, default=CKPT_EVERY,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.worker:
+        return run_worker(args)
+    return run_gate(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
