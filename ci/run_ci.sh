@@ -44,6 +44,15 @@ if ! grep -q -- "-> FAIL" "$FORCED_LOG"; then
   exit 1
 fi
 
+echo "== auto-remat gate (analysis/remat.py: BERT-base predicted peak must"
+echo "   drop >=30%, negative control: flag off => zero segments)"
+JAX_PLATFORMS=cpu python tools/remat_check.py --check \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_remat_report.json"
+
+echo "== XLA compile-option sweep (FLAGS_xla_options plumbing; ranked JSON)"
+JAX_PLATFORMS=cpu python tools/xla_sweep.py --ci \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_xla_sweep.json" | tail -4
+
 echo "== chaos gate (paddle_tpu.resilience: kill-mid-checkpoint + transient"
 echo "   compile faults must resume from the last verified checkpoint)"
 JAX_PLATFORMS=cpu python tools/chaos_check.py --check \

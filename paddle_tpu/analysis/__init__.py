@@ -10,6 +10,9 @@ Public surface:
 * ``liveness`` — dataflow liveness & effect analysis: proven-safe buffer
   donation (``safe_donation_set``), peak-memory planning (``memory_plan``,
   surfaced as ``Program.memory_plan()``), PT5xx diagnostics.
+* ``remat`` — Pass 6, automatic rematerialisation: memory_plan-scored
+  checkpoint selection + program rebuild (``auto_recompute_program``),
+  wired to the executor via ``FLAGS_auto_recompute`` (docs/PERF_NOTES.md).
 * ``CODES`` — the diagnostic-code table (see docs/ANALYSIS.md).
 """
 from .diagnostics import (CODES, Diagnostic, ProgramVerificationError,
@@ -19,6 +22,9 @@ from .verifier import DEFAULT_PASSES, check_program, verify_program
 from . import liveness
 from .liveness import (MemoryPlan, block_liveness, classify_op_effects,
                        donation_report, memory_plan, safe_donation_set)
+from . import remat
+from .remat import (RematCandidate, RematDecision, auto_recompute_program,
+                    remat_candidates)
 
 __all__ = [
     "CODES", "Diagnostic", "ProgramVerificationError", "Severity",
@@ -26,4 +32,6 @@ __all__ = [
     "format_audit", "DEFAULT_PASSES", "check_program", "verify_program",
     "liveness", "MemoryPlan", "block_liveness", "classify_op_effects",
     "donation_report", "memory_plan", "safe_donation_set",
+    "remat", "RematCandidate", "RematDecision", "auto_recompute_program",
+    "remat_candidates",
 ]

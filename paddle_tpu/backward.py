@@ -204,17 +204,21 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
         acc = _append_backward_core(block, [loss], None,
                                     set(no_grad_set or ()))
 
-    params = (program.all_parameters() if parameter_list is None else [
-        block._var_recursive(p) if isinstance(p, str) else p
-        for p in parameter_list
-    ])
-    result = []
-    for p in params:
-        if isinstance(p, Parameter) and not p.trainable:
-            continue
-        g = acc.resolve(p.name)
-        if g is not None:
-            result.append((p, block.var(g)))
+        params = (program.all_parameters() if parameter_list is None else [
+            block._var_recursive(p) if isinstance(p, str) else p
+            for p in parameter_list
+        ])
+        result = []
+        for p in params:
+            if isinstance(p, Parameter) and not p.trainable:
+                continue
+            # resolve() may append a grad-accumulation sum op (multi-use
+            # params, e.g. a tied embedding); it must carry the backward
+            # role or clone(for_test=True) would keep it dangling after
+            # its @GRAD inputs are pruned
+            g = acc.resolve(p.name)
+            if g is not None:
+                result.append((p, block.var(g)))
     return result
 
 

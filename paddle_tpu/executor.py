@@ -459,6 +459,54 @@ class Executor:
         self._step_counter = 0
         # program fingerprints already verified under FLAGS_check_program
         self._verified: set = set()
+        # FLAGS_auto_recompute: (program fingerprint, batch, budget) ->
+        # transformed program (or the original when the pass refused).
+        # The transformed program is a fresh Program with its own _serial,
+        # so step-cache keys can never alias remat and plain variants.
+        self._remat_cache: Dict[tuple, Program] = {}
+
+    def _maybe_auto_remat(self, program: Program, feed, fetch_names):
+        """FLAGS_auto_recompute entry shared by run / run_chained /
+        CompiledProgram: swap a training program for its auto-checkpointed
+        rebuild (analysis/remat.py). Inference programs, pipeline programs
+        and anything the pass cannot faithfully rebuild pass through
+        untouched. Decisions are cached per (program, batch, budget)."""
+        from .flags import flag
+
+        if not flag("auto_recompute") or not isinstance(program, Program):
+            return program
+        batch = 1
+        for v in (feed or {}).values():
+            shape, _ = _shape_dtype_sig(v)
+            if shape:
+                batch = max(batch, int(shape[0]))
+        budget = int(flag("remat_budget_mb"))
+        # fetch_names are part of the key: a transform built for one fetch
+        # list keeps only THOSE fetches alive across segments, so a later
+        # run fetching a different activation needs its own rebuild. The
+        # lookup comes before any program scan so steady-state dispatches
+        # pay one dict probe, nothing op-count-shaped.
+        key = (self._program_fingerprint(program), batch, budget,
+               tuple(fetch_names or ()))
+        cached = self._remat_cache.get(key)
+        if cached is not None:
+            return cached
+        from .analysis.remat import (auto_recompute_program,
+                                     is_trainable_program)
+
+        # startup/inference programs cannot remat by construction; pass
+        # through (cached) with no monitor record — a 'refused' count here
+        # would read as a training program the pass could not handle
+        if not is_trainable_program(program):
+            self._remat_cache[key] = program
+            return program
+        decision = auto_recompute_program(
+            program, feed_names=sorted(feed or {}),
+            fetch_names=list(fetch_names or ()),
+            batch_size=batch, budget_mb=budget)
+        _monitor.record_remat(decision)
+        self._remat_cache[key] = decision.program
+        return decision.program
 
     def _verify_once(self, program: Program, fetch_names) -> None:
         """FLAGS_check_program pre-run hook: static-verify each program
@@ -507,6 +555,7 @@ class Executor:
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in (fetch_list or [])]
 
+        program = self._maybe_auto_remat(program, feed, fetch_names)
         self._verify_once(program, fetch_names)
         mrec = _monitor.step_begin("run", program)
         try:
@@ -637,11 +686,15 @@ class Executor:
                 "run_chained with PipelineOptimizer programs: the pipeline "
                 "step is already a scan; nest via GradientMergeOptimizer")
 
+        program = self._maybe_auto_remat(program, feed, fetch_names)
         self._verify_once(program, fetch_names)
+        from .flags import xla_options
+
+        xla_opts = tuple(sorted(xla_options().items()))
         feed_sig = tuple(sorted(
             (n,) + _shape_dtype_sig(v) for n, v in feed.items()))
         key = ("chained", self._program_fingerprint(program), feed_sig,
-               tuple(fetch_names), int(steps), scope._serial)
+               tuple(fetch_names), int(steps), scope._serial, xla_opts)
         step = self._cache.get(key)
         mrec = _monitor.step_begin("chained", program)
         if mrec is not None:
@@ -717,7 +770,11 @@ class Executor:
                     body, (carried_init, wo_init, jnp.float32(0)), keys)
                 return stacked, fin_carried, fin_wo
 
-            jitted = jax.jit(multi_fn, donate_argnums=(1,))
+            from .flags import xla_options
+
+            opts = xla_options()
+            jitted = jax.jit(multi_fn, donate_argnums=(1,),
+                             compiler_options=opts or None)
             step = _CompiledStep(jitted, io["feed_order"], io["donated"],
                                  ro_names, io["state_out"],
                                  tuple(fetch_names))
@@ -730,6 +787,7 @@ class Executor:
                     "fetch_list": tuple(fetch_names),
                     "scope": scope._serial,
                     "steps": int(steps),
+                    "xla_options": tuple(sorted(opts.items())),
                 },
                 donated_names=io["donated"])
             step.kept_names = kept
@@ -854,6 +912,7 @@ class Executor:
     def close(self):
         self._cache.clear()
         self._verified.clear()
+        self._remat_cache.clear()
 
     # -- internals -------------------------------------------------------
     def _next_seed(self, program: Program) -> int:
@@ -893,10 +952,12 @@ class Executor:
         feed_sig = tuple(sorted(
             (n,) + _shape_dtype_sig(v) for n, v in feed.items()
         ))
-        from .flags import flag
+        from .flags import flag, xla_options
 
+        xla_opts = tuple(sorted(xla_options().items()))
         key = (self._program_fingerprint(program), feed_sig,
-               tuple(fetch_names), scope._serial, flag("check_nan_inf"))
+               tuple(fetch_names), scope._serial, flag("check_nan_inf"),
+               xla_opts)
         hit = use_cache and key in self._cache
         _monitor.record_cache_lookup("run", hit)
         if mrec is not None:
@@ -915,20 +976,22 @@ class Executor:
                 "fetch_list": tuple(fetch_names),
                 "scope": scope._serial,
                 "flags": (("check_nan_inf", flag("check_nan_inf")),),
+                "xla_options": xla_opts,
             },
             donated_names=step.donated_names)
         self._cache[key] = step
         return step
 
     def _compile(self, program: Program, feed_names: set, fetch_names, scope):
-        from .flags import flag
+        from .flags import flag, xla_options
 
         block = program.global_block
         io = analyze_block_io(block, feed_names, fetch_names)
         meta = [] if flag("check_nan_inf") else None
         step_fn = pick_step_fn(program)(block, io, fetch_names,
                                         nan_check_meta=meta)
-        jitted = jax.jit(step_fn, donate_argnums=(1,))
+        jitted = jax.jit(step_fn, donate_argnums=(1,),
+                         compiler_options=xla_options() or None)
         step = _CompiledStep(jitted, io["feed_order"], io["donated"],
                              io["ro"], io["state_out"], tuple(fetch_names))
         step.kept_names = [n for n in io["ro"] if n in io["state_out"]]

@@ -10,7 +10,7 @@ from __future__ import annotations
 import os
 from typing import Any, Dict
 
-__all__ = ["get_flags", "set_flags", "flag"]
+__all__ = ["get_flags", "set_flags", "flag", "xla_options"]
 
 # name -> (type, default, meaning)
 _DEFS: Dict[str, tuple] = {
@@ -68,6 +68,27 @@ _DEFS: Dict[str, tuple] = {
     "retry_timeout": (float, 30.0,
                       "per-site wall-clock retry budget in seconds across "
                       "all attempts (0 = unlimited)"),
+    "auto_recompute": (bool, False,
+                       "automatic rematerialisation: on Executor.run / "
+                       "run_chained / CompiledProgram, training programs "
+                       "are segmented at layer boundaries and gradient-"
+                       "checkpointed (analysis/remat.py Pass 6), with the "
+                       "checkpoint set chosen by Program.memory_plan() "
+                       "scoring. Transformed programs get their own serial "
+                       "so compile caches never alias remat and plain "
+                       "variants. docs/PERF_NOTES.md"),
+    "remat_budget_mb": (int, 0,
+                        "peak-memory target for FLAGS_auto_recompute in "
+                        "MiB: the cheapest checkpoint set (fewest "
+                        "recomputed ops) whose PREDICTED peak fits is "
+                        "chosen; 0 = no budget, sqrt(N) segmentation"),
+    "xla_options": (str, "",
+                    "XLA compiler options forwarded to jax.jit("
+                    "compiler_options=...) on every executor compile; "
+                    "JSON object or comma-separated k=v pairs, e.g. "
+                    "'{\"xla_tpu_enable_latency_hiding_scheduler\": true}' "
+                    "or 'xla_cpu_enable_fast_min_max=true'. Part of the "
+                    "compile-cache key; sweep with tools/xla_sweep.py"),
     "paddle_num_threads": (int, 1, "host threads hint (XLA owns scheduling)"),
     "seq_bucket_sizes": (str, "", "override DataFeeder varlen buckets, csv"),
     "conv_use_nhwc": (str, "auto",
@@ -126,6 +147,64 @@ def get_flags(names=None) -> Dict[str, Any]:
         names = [names]
     return {f"FLAGS_{n}": flag(n) for n in (x.replace("FLAGS_", "")
                                             for x in names)}
+
+
+def _parse_option_value(s: str):
+    t = s.strip()
+    low = t.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    for conv in (int, float):
+        try:
+            return conv(t)
+        except ValueError:
+            pass
+    return t
+
+
+# raw flag string -> parsed dict; the executor consults xla_options() on
+# every dispatch to build cache keys, so parsing must not be per-step work
+_xla_options_memo: Dict[str, Dict[str, Any]] = {}
+
+
+def xla_options() -> Dict[str, Any]:
+    """``FLAGS_xla_options`` parsed to the dict handed to
+    ``jax.jit(compiler_options=...)``: a JSON object, or comma-separated
+    ``k=v`` pairs with true/false/number coercion. The executor folds
+    ``sorted(items())`` into every compile-cache key, so flipping options
+    recompiles instead of silently reusing the old executable. Parses are
+    memoized on the raw string (callers must not mutate the result)."""
+    raw = str(flag("xla_options")).strip()
+    cached = _xla_options_memo.get(raw)
+    if cached is not None:
+        return cached
+    _xla_options_memo[raw] = opts = _parse_xla_options(raw)
+    return opts
+
+
+def _parse_xla_options(raw: str) -> Dict[str, Any]:
+    if not raw:
+        return {}
+    if raw.startswith("{"):
+        import json
+
+        opts = json.loads(raw)
+        if not isinstance(opts, dict):
+            raise ValueError(
+                f"FLAGS_xla_options JSON must be an object, got {opts!r}")
+        return opts
+    out: Dict[str, Any] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"FLAGS_xla_options entry {part!r} is not k=v "
+                f"(or pass a JSON object)")
+        k, v = part.split("=", 1)
+        out[k.strip()] = _parse_option_value(v)
+    return out
 
 
 def set_flags(flags_dict: Dict[str, Any]) -> None:

@@ -50,8 +50,8 @@ __all__ = [
     "add_hook", "remove_hook", "clear_hooks", "get_registry", "counter",
     "gauge", "histogram", "metric_value", "enabled", "record_cache_lookup",
     "observe_compile", "complete_compile", "step_begin", "step_end",
-    "recompile_events", "recompile_count", "snapshot", "reset",
-    "get_tracker", "build_site",
+    "record_remat", "recompile_events", "recompile_count", "snapshot",
+    "reset", "get_tracker", "build_site",
 ]
 
 _step_counter = itertools.count()
@@ -172,6 +172,29 @@ def step_end(rec: Optional[StepRecord]) -> None:
         counter("executor_donated_bytes_total",
                 "live bytes of donated buffers").inc(rec.donated_bytes)
     dispatch("step_end", rec)
+
+
+def record_remat(decision) -> None:
+    """Record one FLAGS_auto_recompute decision (analysis/remat.py
+    RematDecision): how many programs were transformed vs refused, segments
+    inserted, and the planner's predicted peak bytes for the plain and
+    remat variants (docs/OBSERVABILITY.md)."""
+    if not enabled():
+        return
+    counter("remat_programs_total",
+            "auto-remat decisions by outcome").labels(
+        outcome="applied" if decision.applied else "refused").inc()
+    if not decision.applied:
+        return
+    counter("remat_segments_inserted_total",
+            "recompute segments inserted by FLAGS_auto_recompute").inc(
+        decision.n_segments)
+    gauge("remat_predicted_peak_bytes",
+          "memory_plan predicted peak of the last transformed program, "
+          "by variant").labels(variant="plain").set(decision.peak_before)
+    gauge("remat_predicted_peak_bytes",
+          "memory_plan predicted peak of the last transformed program, "
+          "by variant").labels(variant="remat").set(decision.peak_after)
 
 
 # -- introspection ---------------------------------------------------------

@@ -15,7 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..core.types import np_dtype
+from ..core.types import jnp_dtype
 from .common import IOSpec, broadcast_to_x, out, register_op, unary, x
 
 # -- activations ------------------------------------------------------------
@@ -247,7 +247,9 @@ def _sum(ctx, ins, attrs):
 @register_op("cast", inputs=["X"], outputs=["Out"],
              attrs={"in_dtype": None, "out_dtype": "float32"})
 def _cast(ctx, ins, attrs):
-    return out(x(ins).astype(np_dtype(attrs["out_dtype"])))
+    # jnp_dtype, not np_dtype: an int64 cast under disabled x64 would emit
+    # a truncation UserWarning per traced op before downcasting anyway
+    return out(x(ins).astype(jnp_dtype(attrs["out_dtype"])))
 
 
 @register_op("clip", inputs=["X"], outputs=["Out"], attrs={"min": -1.0, "max": 1.0})

@@ -11,7 +11,6 @@ import numpy as np
 
 from ..core.types import jnp_dtype
 from .common import IOSpec, out, register_op, x
-from .tensor import np_dtype as _np_dtype
 
 
 # ---------------------------------------------------------------------------
@@ -27,7 +26,7 @@ def _linspace(ctx, ins, attrs):
     stop = float(np.asarray(x(ins, "Stop")).reshape(-1)[0])
     num = int(np.asarray(x(ins, "Num")).reshape(-1)[0])
     return out(jnp.linspace(start, stop, num,
-                            dtype=_np_dtype(attrs["dtype"])))
+                            dtype=jnp_dtype(attrs["dtype"])))
 
 
 @register_op("fill", outputs=["Out"],
@@ -35,7 +34,7 @@ def _linspace(ctx, ins, attrs):
                     "force_cpu": False}, grad=None)
 def _fill(ctx, ins, attrs):
     """reference fill_op.cc: fill Out with an explicit value list."""
-    vals = jnp.asarray(attrs["value"], _np_dtype(attrs["dtype"]))
+    vals = jnp.asarray(attrs["value"], jnp_dtype(attrs["dtype"]))
     return out(vals.reshape([int(s) for s in attrs["shape"]]))
 
 
@@ -44,7 +43,7 @@ def _fill(ctx, ins, attrs):
 def _fill_any_like(ctx, ins, attrs):
     xv = x(ins)
     dt = xv.dtype if attrs.get("dtype", -1) in (-1, None) \
-        else _np_dtype(attrs["dtype"])
+        else jnp_dtype(attrs["dtype"])
     return out(jnp.full(xv.shape, attrs["value"], dt))
 
 
@@ -115,7 +114,7 @@ def _unique(ctx, ins, attrs):
     order = jnp.where(is_first, jnp.arange(n), n)
     perm = jnp.argsort(order)
     uniq = xv[perm]                                 # firsts first, pad tail
-    return {"Out": [uniq], "Index": [index.astype(_np_dtype(
+    return {"Out": [uniq], "Index": [index.astype(jnp_dtype(
         attrs.get("dtype", "int32")))]}
 
 
@@ -436,7 +435,7 @@ def _one_hot_v2(ctx, ins, attrs):
     ids = jnp.asarray(x(ins)).astype(jnp.int32)
     depth = int(attrs["depth"])
     return out(jax.nn.one_hot(ids, depth,
-                              dtype=_np_dtype(attrs["dtype"])))
+                              dtype=jnp_dtype(attrs["dtype"])))
 
 
 @register_op("cross_entropy2", inputs=[IOSpec("X"),

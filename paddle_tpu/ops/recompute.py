@@ -105,7 +105,7 @@ register_op("recompute_segment",
             infer_shape=lambda op, block: None)(_recompute_segment_lower)
 
 
-def insert_recompute_segments(loss, checkpoints) -> int:
+def insert_recompute_segments(loss, checkpoints, extra_live=()) -> int:
     """Rewrite ``loss``'s block: forward ops up to each checkpoint collapse
     into ``recompute_segment`` ops. Returns the number of segments created.
 
@@ -113,6 +113,12 @@ def insert_recompute_segments(loss, checkpoints) -> int:
     internal to a segment are demoted to sub-block locals — they no longer
     exist between forward and backward, which is the entire point; fetching
     them from user code stops working (same trade the reference makes).
+
+    ``extra_live`` names are treated as observed-after-every-cut (kept as
+    segment outputs, never demoted): the auto-remat pass
+    (analysis/remat.py) passes fetch names and optimizer-tail reads here so
+    a TRANSPARENT transform never breaks a fetch the manual API is allowed
+    to break.
     """
     block = loss.block
     program = block.program
@@ -131,7 +137,8 @@ def insert_recompute_segments(loss, checkpoints) -> int:
     # names read after each cut index, plus names that must survive:
     # checkpoints themselves, persistables, the loss. One reverse sweep,
     # snapshotting the running read-set only at the cut positions.
-    keep_always = set(ckpt_names) | {loss.name}
+    keep_always = set(ckpt_names) | {loss.name} | {
+        n for n in extra_live if n != EMPTY_VAR_NAME}
     reads_after_cut = {}
     running: set = set()
     cut_set = set(cuts)

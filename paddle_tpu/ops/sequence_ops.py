@@ -275,7 +275,7 @@ def _sequence_conv(ctx, ins, attrs):
              outputs=["Y"], attrs={"maxlen": -1, "out_dtype": "float32"})
 def _sequence_mask(ctx, ins, attrs):
     """reference sequence_mask_op.h: lengths -> [.., maxlen] 0/1 mask."""
-    from ..core.types import np_dtype
+    from ..core.types import jnp_dtype
 
     ln = x(ins, "X")
     maxlen = attrs["maxlen"]
@@ -283,4 +283,6 @@ def _sequence_mask(ctx, ins, attrs):
         raise ValueError("sequence_mask on TPU needs a static maxlen attr")
     m = jnp.arange(maxlen)[None, :] < ln.reshape(-1, 1)
     m = m.reshape(tuple(ln.shape) + (maxlen,))
-    return {"Y": [m.astype(np_dtype(attrs["out_dtype"]))]}
+    # jnp_dtype: int64 out_dtype must canonicalize before the astype or
+    # every trace warns about the x64 truncation
+    return {"Y": [m.astype(jnp_dtype(attrs["out_dtype"]))]}

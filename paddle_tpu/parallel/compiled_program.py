@@ -130,7 +130,10 @@ class CompiledProgram:
         feed = feed or {}
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in (fetch_list or [])]
-        program = self._program
+        # FLAGS_auto_recompute: the data-parallel path shares the executor's
+        # remat cache; the transformed program's fresh _serial keys this
+        # CompiledProgram's own step cache apart from the plain variant
+        program = exe._maybe_auto_remat(self._program, feed, fetch_names)
         mrec = _monitor.step_begin("parallel", program)
         try:
             return self._run_body(exe, program, feed, fetch_names, scope,
@@ -225,10 +228,11 @@ class CompiledProgram:
         feed_sig = tuple(sorted(
             (n,) + _shape_dtype_sig(v) for n, v in feed.items()
         ))
-        from ..flags import flag
+        from ..flags import flag, xla_options
 
+        xla_opts = tuple(sorted(xla_options().items()))
         key = (exe._program_fingerprint(program), feed_sig,
-               tuple(fetch_names), flag("check_nan_inf"))
+               tuple(fetch_names), flag("check_nan_inf"), xla_opts)
         hit = key in self._cache
         _monitor.record_cache_lookup("parallel", hit)
         if mrec is not None:
@@ -256,6 +260,7 @@ class CompiledProgram:
                 "feed_signature": feed_sig,
                 "fetch_list": tuple(fetch_names),
                 "flags": (("check_nan_inf", flag("check_nan_inf")),),
+                "xla_options": xla_opts,
             },
             donated_names=step.donated_names), None, None)
         self._cache[key] = step
@@ -266,7 +271,7 @@ class CompiledProgram:
         over the mesh: feeds split on 'dp', state replicated."""
         from ..executor import _CompiledStep, analyze_block_io, pick_step_fn
 
-        from ..flags import flag
+        from ..flags import flag, xla_options
 
         block = program.global_block
         io = analyze_block_io(block, feed_names, fetch_names)
@@ -326,7 +331,8 @@ class CompiledProgram:
             out_shardings = out_shardings + (repl_spec,)
         jitted = jax.jit(step_fn, donate_argnums=(1,),
                          in_shardings=in_shardings,
-                         out_shardings=out_shardings)
+                         out_shardings=out_shardings,
+                         compiler_options=xla_options() or None)
         step = _CompiledStep(jitted, io["feed_order"], io["donated"],
                              io["ro"], io["state_out"], tuple(fetch_names))
         step.kept_names = [n for n in io["ro"] if n in io["state_out"]]
