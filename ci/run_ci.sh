@@ -71,6 +71,13 @@ if ! grep -q -- "-> FAIL" "$CHAOS_NEG_LOG"; then
   exit 1
 fi
 
+echo "== chaos multichip gate (resilience.distributed: kill inside one shard"
+echo "   write -> serial unpublished + bit-identical resume; elastic 8->4->1"
+echo "   restore; watchdog converts an injected hang, and without it the"
+echo "   run provably hangs)"
+python tools/chaos_check.py --check --multichip \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_chaos_dist_report.json"
+
 echo "== unit tests (CPU, 8 virtual devices; FLAGS_check_program on via conftest)"
 python -m pytest tests/ -q -x
 

@@ -32,8 +32,11 @@ _CANONICAL = {
     "int8": "int8",
     "uint8": "uint8",
     "int16": "int16",
+    "uint16": "uint16",
     "int32": "int32",
+    "uint32": "uint32",
     "int64": "int64",
+    "uint64": "uint64",
     "bool": "bool",
     # aliases
     "fp32": "float32",
@@ -85,15 +88,23 @@ def jnp_dtype(dtype) -> np.dtype:
     jax.random.*, jnp.arange...): with ``jax_enable_x64`` off, explicitly
     requesting int64/float64 makes every call site emit a truncation
     warning before silently downcasting — spamming bench output once per
-    traced op. Canonicalize here instead: request the 32-bit type jax will
-    deliver anyway. Host-side numpy arrays (feeds, serialized attrs) keep
-    full width via ``np_dtype``."""
+    traced op. Canonicalize here instead: request exactly the type jax
+    will deliver anyway. ``jax.dtypes.canonicalize_dtype`` is the
+    authoritative answer (a hand-rolled ``jax.config.jax_enable_x64``
+    check broke on jax versions where that attribute is a holder object —
+    always truthy — which re-opened the int64 warning spam on the
+    multichip dryrun); the manual fallback only covers jax builds without
+    the public helper. Host-side numpy arrays (feeds, serialized attrs)
+    keep full width via ``np_dtype``."""
     dt = np_dtype(dtype)
     import jax
 
-    if not jax.config.jax_enable_x64 and dt.name in _X64_FALLBACK:
-        return np.dtype(_X64_FALLBACK[dt.name])
-    return dt
+    try:
+        return np.dtype(jax.dtypes.canonicalize_dtype(dt))
+    except (AttributeError, TypeError, ValueError):
+        if not jax.config.jax_enable_x64 and dt.name in _X64_FALLBACK:
+            return np.dtype(_X64_FALLBACK[dt.name])
+        return dt
 
 
 def is_floating(dtype) -> bool:

@@ -32,6 +32,7 @@ from .core.types import np_dtype
 from .framework import Program, Variable, default_main_program
 from .lowering import LowerCtx, lower_block, lower_op
 from .profiler import RecordEvent
+from .resilience import distributed as _dist
 from .resilience import faults as _faults
 from .resilience import nonfinite as _nonfinite
 from .resilience.retry import RetryExhaustedError, call_with_retry
@@ -618,7 +619,12 @@ class Executor:
                 donated_vals = _own_donated(donated_vals)
             fn = self._ensure_executable(
                 step, (feed_vals, donated_vals, ro_vals, key))
-            with RecordEvent("executor::step"):
+            # watchdog-armed dispatch: a hang here (injected via the
+            # 'hang' fault site, or a real stuck collective) is dumped +
+            # raised as WatchdogTimeout under FLAGS_step_timeout_s
+            with RecordEvent("executor::step"), \
+                    _dist.watchdog_section("step", program=program) as tok:
+                _faults.fault_point("hang")
                 try:
                     result = fn(feed_vals, donated_vals, ro_vals, key)
                 except (TypeError, ValueError):
@@ -632,6 +638,12 @@ class Executor:
                     # fast path for this step
                     step._aot = False
                     result = step.fn(feed_vals, donated_vals, ro_vals, key)
+                if tok is not None:
+                    # dispatch is async — without this the section would
+                    # disarm before a stuck device computation ever ran.
+                    # Only under FLAGS_step_timeout_s, which opts into
+                    # deadline-over-overlap
+                    jax.block_until_ready(result)
         fetches, new_state = unpack_step_result(step, result, scope,
                                                 path="run", exe=self,
                                                 rollback=rollback)
@@ -866,7 +878,10 @@ class Executor:
             args = (feed_vals, donated_vals, kept_vals, ro_vals, keys,
                     wo_init, jnp.float32(0))
             fn = self._ensure_executable(step, args)
-            with RecordEvent("executor::run_chained"):
+            with RecordEvent("executor::run_chained"), \
+                    _dist.watchdog_section("chained",
+                                           program=program) as tok:
+                _faults.fault_point("hang")
                 try:
                     stacked, fin_carried, fin_wo = fn(*args)
                 except (TypeError, ValueError):
@@ -874,6 +889,10 @@ class Executor:
                         raise
                     step._aot = False
                     stacked, fin_carried, fin_wo = step.fn(*args)
+                if tok is not None:
+                    # async dispatch: keep the section armed until the
+                    # scanned computation actually finished on device
+                    jax.block_until_ready((stacked, fin_carried, fin_wo))
         if check:
             bad = next((n for n, v in
                         list(zip(step.carried_names, fin_carried))
@@ -1013,15 +1032,19 @@ class Executor:
 
             def _build():
                 # transient-site: compiles hit flaky infra (preempted
-                # backend, cache-server hiccups) — retried with backoff
+                # backend, cache-server hiccups) — retried with backoff.
+                # Watchdog-armed: a hung compile is dumped + raised, not
+                # waited on forever
                 _faults.fault_point("compile")
-                t0 = time.perf_counter()
-                with RecordEvent("executor::trace_lower"):
-                    lowered = step.fn.lower(*args)
-                t1 = time.perf_counter()
-                with RecordEvent("executor::xla_compile"):
-                    compiled = lowered.compile()
-                return compiled, t1 - t0, time.perf_counter() - t1
+                with _dist.watchdog_section("compile",
+                                            program=step.program):
+                    t0 = time.perf_counter()
+                    with RecordEvent("executor::trace_lower"):
+                        lowered = step.fn.lower(*args)
+                    t1 = time.perf_counter()
+                    with RecordEvent("executor::xla_compile"):
+                        compiled = lowered.compile()
+                    return compiled, t1 - t0, time.perf_counter() - t1
 
             try:
                 step._aot, t_trace, t_compile = \
@@ -1033,6 +1056,10 @@ class Executor:
                     # back to a jit path the plan never faulted
                     raise
                 step._aot = False   # real persistent failure: jit fallback
+            except _dist.WatchdogTimeout:
+                # a diagnosed hang must FAIL, never silently fall back to
+                # a jit retry of the same hung build
+                raise
             except Exception:
                 # user trace/shape errors surface through the jit path so
                 # the original diagnostic is what the user sees

@@ -53,7 +53,41 @@ _DEFS: Dict[str, tuple] = {
                    "deterministic fault-injection schedule, e.g. "
                    "'compile:2:RuntimeError,ckpt_write:1:kill' "
                    "(paddle_tpu.resilience.faults; sites: compile, "
-                   "device_put, step, ckpt_write). Empty disables"),
+                   "device_put, step, ckpt_write, shard_write, hang; "
+                   "actions add 'hang' — an interruptible stall the step "
+                   "watchdog must break). Empty disables"),
+    "step_timeout_s": (float, 0.0,
+                       "step watchdog (resilience.distributed): arm a "
+                       "deadline around compile/step/collective sections; "
+                       "on expiry all thread stacks + the active program "
+                       "serial + the last recompile diagnosis are dumped "
+                       "and the section raises WatchdogTimeout instead of "
+                       "hanging CI forever. 0 disables (default). "
+                       "docs/RESILIENCE.md"),
+    "watchdog_hard_exit": (bool, True,
+                           "after a watchdog expiry, if the hung section "
+                           "is still armed one extra timeout later (stuck "
+                           "in uninterruptible native code), os._exit(124)"
+                           " with the diagnosis already on stderr — a "
+                           "diagnosed fast failure beats a CI wall-clock "
+                           "kill. Off: dump + raise only"),
+    "replica_check_interval": (int, 0,
+                               "every N-th data-parallel step, checksum "
+                               "replicated params/optimizer state across "
+                               "the dp axis (jitted reduce, no host "
+                               "gather) and trip ReplicaDivergenceError "
+                               "naming the first diverged param when "
+                               "replicas disagree. 0 disables (default). "
+                               "docs/RESILIENCE.md"),
+    "replica_divergence_policy": (str, "raise",
+                                  "what a detected cross-replica "
+                                  "divergence does: raise "
+                                  "(ReplicaDivergenceError), or restore "
+                                  "(roll back to the last verified "
+                                  "checkpoint via the registered recovery"
+                                  " walk — contrib.Trainer wires it — "
+                                  "and keep training; escalates to raise "
+                                  "when nothing restorable exists)"),
     "fault_seed": (int, 0,
                    "seed for probabilistic fault-plan rules and retry "
                    "jitter — the same plan+seed replays identically"),

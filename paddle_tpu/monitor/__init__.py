@@ -50,8 +50,8 @@ __all__ = [
     "add_hook", "remove_hook", "clear_hooks", "get_registry", "counter",
     "gauge", "histogram", "metric_value", "enabled", "record_cache_lookup",
     "observe_compile", "complete_compile", "step_begin", "step_end",
-    "record_remat", "recompile_events", "recompile_count", "snapshot",
-    "reset", "get_tracker", "build_site",
+    "record_remat", "record_watchdog_timeout", "recompile_events",
+    "recompile_count", "snapshot", "reset", "get_tracker", "build_site",
 ]
 
 _step_counter = itertools.count()
@@ -172,6 +172,20 @@ def step_end(rec: Optional[StepRecord]) -> None:
         counter("executor_donated_bytes_total",
                 "live bytes of donated buffers").inc(rec.donated_bytes)
     dispatch("step_end", rec)
+
+
+def record_watchdog_timeout(section: str) -> None:
+    """Account one step-watchdog expiry (resilience.distributed): the
+    section name is the armed region (compile / step / chained /
+    parallel_step / collective). The dump itself — thread stacks, active
+    program serial, last recompile diagnosis — goes to the resilience
+    logger and stderr; this records the event on the registry so CI
+    artifacts show it (docs/OBSERVABILITY.md)."""
+    if not enabled():
+        return
+    counter("watchdog_timeouts_total",
+            "watchdog deadlines that expired (hangs converted to "
+            "diagnosed failures)").labels(section=section).inc()
 
 
 def record_remat(decision) -> None:

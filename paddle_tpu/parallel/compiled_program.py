@@ -28,6 +28,7 @@ from ..framework import Program, Variable
 from ..executor import _feed_host_bytes, _live_bytes, _shape_dtype_sig
 from ..lowering import LowerCtx, lower_block
 from ..profiler import RecordEvent
+from ..resilience import distributed as _dist
 from ..resilience import faults as _faults
 from ..resilience import nonfinite as _nonfinite
 from ..resilience.retry import call_with_retry
@@ -204,9 +205,19 @@ class CompiledProgram:
             mrec.donated_buffers = len(step.donated_names)
             mrec.kept_buffers = len(step.kept_names)
             mrec.donated_bytes = _live_bytes(donated_vals)
-        with RecordEvent("executor::parallel_step"):
+        # the parallel dispatch IS the collective section: a stuck ICI
+        # collective here used to hang CI forever; under
+        # FLAGS_step_timeout_s the watchdog dumps + raises instead
+        with RecordEvent("executor::parallel_step"), \
+                _dist.watchdog_section("parallel_step",
+                                       program=program) as tok:
+            _faults.fault_point("hang")
             result = step.fn(feed_vals, donated_vals,
                              read(step.ro_names), key)
+            if tok is not None:
+                # async dispatch: a wedged collective only blocks at the
+                # first result read — keep the section armed through it
+                jax.block_until_ready(result)
         from ..executor import unpack_step_result
 
         fetches, new_state = unpack_step_result(step, result, scope,
@@ -216,12 +227,49 @@ class CompiledProgram:
         if new_state is not None:
             for n, v in zip(step.state_out_names, new_state):
                 scope.set_var(n, v)
+        self._maybe_check_replicas(step, scope)
         if return_numpy:
             outs = [_fetch_numpy(v) for v in fetches]
             if mrec is not None:
                 mrec.fetch_bytes = _live_bytes(outs)
             return outs
         return list(fetches)
+
+    def _maybe_check_replicas(self, step, scope):
+        """FLAGS_replica_check_interval: every N-th parallel step, verify
+        that state replicated over the dp axis still holds identical bytes
+        on every replica (resilience.distributed — a jitted per-device
+        checksum reduce, no host gather of tensors). Disagreement is
+        handled by FLAGS_replica_divergence_policy."""
+        from ..flags import flag
+
+        interval = int(flag("replica_check_interval"))
+        mesh = self._mesh
+        if interval <= 0 or mesh is None \
+                or mesh.shape.get("dp", 1) <= 1:
+            return
+        self._replica_steps = getattr(self, "_replica_steps", 0) + 1
+        if self._replica_steps % interval:
+            return
+        values = {}
+        for n in step.state_out_names:
+            v = scope.find_var(n)
+            if not isinstance(v, jax.Array):
+                continue
+            if getattr(v.sharding, "mesh", None) != mesh:
+                continue
+            values[n] = v
+        if not values:
+            return
+        if _monitor.enabled():
+            _monitor.counter(
+                "resilience_divergence_checks_total",
+                "cross-replica consistency sweeps run").inc()
+        # axis=None: compare across EVERY axis a var is replicated over
+        # (on a dp x tp mesh that covers both replica directions)
+        diverged = _dist.replica_divergence_check(mesh, values)
+        if diverged:
+            _dist.handle_divergence(diverged, path="parallel", axis="dp")
 
     def _get_compiled(self, exe, program, feed, fetch_names, scope,
                       mrec=None):
@@ -246,7 +294,8 @@ class CompiledProgram:
         # is retried: a real build failure must surface its ORIGINAL
         # diagnostic immediately, exactly like the single-device path
         call_with_retry("compile", _faults.fault_point, "compile")
-        with RecordEvent("executor::build_step"):
+        with RecordEvent("executor::build_step"), \
+                _dist.watchdog_section("compile", program=program):
             step = self._compile(program, set(feed.keys()), fetch_names,
                                  scope)
         step.program = program

@@ -146,7 +146,24 @@ def pipeline(stage_fn: Callable, stacked_params, x, mesh: Mesh,
         stacked_params = jax.device_put(
             stacked_params,
             jax.tree.map(lambda sp: NamedSharding(mesh, sp), pspec))
-    return fn(stacked_params, x)
+    # the GPipe schedule is T = M + P - 1 collective-permutes around the
+    # pp ring; a wedged stage rank stalls every other rank's ppermute
+    # forever. Armed like the executor step sections: dump + raise under
+    # FLAGS_step_timeout_s instead of hanging (a jit-trace caller only
+    # wraps host-side tracing and disarms immediately).
+    from ..resilience.distributed import (block_until_ready_concrete,
+                                          watchdog_section)
+
+    with watchdog_section("collective",
+                          detail=f"pipeline over '{axis_name}' "
+                                 f"({num_microbatches} microbatches)") \
+            as tok:
+        out = fn(stacked_params, x)
+        if tok is not None:
+            # async dispatch: arm through device completion (no-op when
+            # called inside a jit trace; real runtime errors propagate)
+            block_until_ready_concrete(out)
+        return out
 
 
 def _needs_place(tree, mesh) -> bool:

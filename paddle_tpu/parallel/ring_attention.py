@@ -203,7 +203,22 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "sp",
                 scale=scale, use_flash=use_flash),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=not use_flash)
-    return fn(q, k, v)
+    # eager dispatches ride the ICI ring (P ppermute rotations) — the one
+    # collective in the stack with no deadline until now; armed so a stuck
+    # permute is dumped + raised under FLAGS_step_timeout_s (inside a jit
+    # trace this wraps only host-side trace work and disarms immediately)
+    from ..resilience.distributed import (block_until_ready_concrete,
+                                          watchdog_section)
+
+    with watchdog_section("collective",
+                          detail=f"ring_attention over '{seq_axis}'") \
+            as tok:
+        out = fn(q, k, v)
+        if tok is not None:
+            # async dispatch: arm through device completion (no-op when
+            # called inside a jit trace; real runtime errors propagate)
+            block_until_ready_concrete(out)
+        return out
 
 
 def attention_reference(q, k, v, causal: bool = False,

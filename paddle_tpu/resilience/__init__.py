@@ -26,6 +26,12 @@ io / executor / contrib.Trainer / monitor:
   raise|skip|zero_grad``: under ``skip`` a tripped step is dropped with the
   scope rolled back bit-exactly (donation-aware: the executor donates
   copies and keeps the originals), N consecutive skips escalate to raise.
+* :mod:`~paddle_tpu.resilience.distributed` — the parallel layer: sharded
+  elastic checkpoints (manifest format_version 2, PT605–PT609), cross-
+  replica divergence detection (``FLAGS_replica_check_interval`` /
+  ``FLAGS_replica_divergence_policy``), and the step watchdog
+  (``FLAGS_step_timeout_s``) that turns hangs into diagnosed failures.
+  CI proof: ``tools/chaos_check.py --multichip``.
 
 Failure model, flag reference and checkpoint format: docs/RESILIENCE.md.
 """
@@ -33,7 +39,11 @@ from __future__ import annotations
 
 from .checkpoint import (CKPT_CODES, FORMAT_VERSION, CheckpointCorruptError,
                          atomic_replace_dir, finalize_manifest, iter_serials,
-                         load_latest_checkpoint, verify_checkpoint)
+                         load_latest_checkpoint, verify_checkpoint,
+                         verify_sharding_section)
+from .distributed import (ReplicaDivergenceError, WatchdogTimeout,
+                          handle_divergence, replica_divergence_check,
+                          set_divergence_recovery, watchdog_section)
 from .faults import (SITES, FaultPlan, InjectedFault, active_plan,
                      clear_plan, fault_plan_guard, fault_point, install_plan)
 from .nonfinite import POLICIES
@@ -43,8 +53,12 @@ from .retry import (RetryExhaustedError, RetryPolicy, call_with_retry,
 __all__ = [
     # checkpoint integrity
     "CheckpointCorruptError", "CKPT_CODES", "FORMAT_VERSION",
-    "verify_checkpoint", "finalize_manifest", "atomic_replace_dir",
-    "iter_serials", "load_latest_checkpoint",
+    "verify_checkpoint", "verify_sharding_section", "finalize_manifest",
+    "atomic_replace_dir", "iter_serials", "load_latest_checkpoint",
+    # distributed resilience (sharded ckpts, divergence, watchdog)
+    "ReplicaDivergenceError", "WatchdogTimeout", "watchdog_section",
+    "replica_divergence_check", "handle_divergence",
+    "set_divergence_recovery",
     # fault injection
     "FaultPlan", "InjectedFault", "fault_point", "fault_plan_guard",
     "install_plan", "clear_plan", "active_plan", "SITES",

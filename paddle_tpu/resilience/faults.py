@@ -21,6 +21,13 @@ site           probed where
 ``ckpt_write`` inside ``io.save_checkpoint`` after the blobs are written but
                BEFORE the manifest/rename — a ``kill`` here leaves a torn
                temp dir, never a torn live checkpoint
+``shard_write`` before EACH per-shard file of a sharded (format_version 2)
+               checkpoint write (``resilience.distributed``) — a ``kill``
+               on shard k models one host of a distributed writer dying
+               mid-checkpoint
+``hang``       inside the watchdog-armed dispatch section of every executor
+               path (run / run_chained / CompiledProgram) — pair with the
+               ``hang`` action to stall a step the watchdog must break
 =============  ==============================================================
 
 Plan grammar (``FLAGS_fault_plan``, comma-separated rules)::
@@ -32,9 +39,12 @@ Plan grammar (``FLAGS_fault_plan``, comma-separated rules)::
 
 Actions: an exception class name (``RuntimeError``, ``OSError``,
 ``TimeoutError``, ``ConnectionError`` — raised as an *injected* subclass so
-handlers can tell injected faults from real ones), or ``kill`` —
+handlers can tell injected faults from real ones), ``kill`` —
 ``os._exit(137)``, a mid-write SIGKILL stand-in that skips every ``finally``
-block exactly like the real signal.
+block exactly like the real signal — or ``hang``: an interruptible stall
+(a loop of short sleeps, so the step watchdog's ``interrupt_main`` can
+break it; a real collective hang blocks in native code and is covered by
+the watchdog's hard-exit escalation instead).
 
 Example: ``FLAGS_fault_plan="compile:2:RuntimeError,ckpt_write:1:kill"``
 makes the first two compile attempts fail transiently (retry/backoff must
@@ -55,7 +65,8 @@ __all__ = ["FaultPlan", "InjectedFault", "fault_point", "install_plan",
 
 logger = logging.getLogger("paddle_tpu.resilience")
 
-SITES = ("compile", "device_put", "step", "ckpt_write")
+SITES = ("compile", "device_put", "step", "ckpt_write", "shard_write",
+         "hang")
 
 # injected exceptions carry this mixin so retry/give-up handlers can tell a
 # scripted fault from a real infrastructure error (real errors keep their
@@ -119,10 +130,10 @@ class FaultPlan:
             if site not in SITES:
                 raise ValueError(f"FLAGS_fault_plan: unknown site '{site}' "
                                  f"(known: {', '.join(SITES)})")
-            if action != "kill" and action not in _BASES:
+            if action not in ("kill", "hang") and action not in _BASES:
                 raise ValueError(
                     f"FLAGS_fault_plan: unknown action '{action}' (known: "
-                    f"kill, {', '.join(sorted(_BASES))})")
+                    f"kill, hang, {', '.join(sorted(_BASES))})")
             rule = _Rule(site=site, action=action)
             if when.startswith("@"):
                 rule.at = int(when[1:])
@@ -158,6 +169,16 @@ class FaultPlan:
                 logger.warning("fault_plan: KILL at site '%s' (hit #%d)",
                                site, k)
                 os._exit(137)
+            if rule.action == "hang":
+                import time
+
+                logger.warning("fault_plan: HANG at site '%s' (hit #%d) — "
+                               "stalling until interrupted", site, k)
+                # short sleeps so a pending interrupt (the watchdog's
+                # interrupt_main) is delivered between iterations; a single
+                # long sleep would ride out the interrupt flag in C
+                while True:
+                    time.sleep(0.02)
             logger.warning("fault_plan: injecting %s at site '%s' (hit #%d)",
                            rule.action, site, k)
             raise _injected_class(rule.action)(

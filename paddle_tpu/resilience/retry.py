@@ -41,6 +41,11 @@ _PERMANENT = (TypeError, ValueError, KeyError, IndexError, AttributeError,
 
 
 def is_transient(exc: BaseException) -> bool:
+    # classes can opt out of retry explicitly (WatchdogTimeout,
+    # ReplicaDivergenceError: RuntimeErrors by type, but retrying a hang
+    # or a determinism bug only delays the diagnosis)
+    if getattr(exc, "transient", None) is False:
+        return False
     if not isinstance(exc, _TRANSIENT) or isinstance(exc, _PERMANENT):
         return False
     # a transient-typed wrapper chained onto a permanent cause is a

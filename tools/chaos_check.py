@@ -31,6 +31,25 @@ Usage:
       DISABLED (FLAGS_retry_max_attempts=1) under a persistent compile
       fault plan: resume must fail and the gate must FAIL (non-zero exit)
       — CI runs this once to prove the gate actually trips.
+  python tools/chaos_check.py --check --multichip --json ci_chaos_dist_report.json
+      Distributed leg (resilience.distributed, 8 virtual CPU devices,
+      ZeRO-sharded Adam state, sharded format_version-2 checkpoints):
+      1. baseline — uninterrupted dp=8 run, sharded checkpoints, final
+         param digest recorded (cross-replica divergence sweep armed the
+         whole way: an honest run must never trip it).
+      2. kill INSIDE one shard's write of the 2nd checkpoint
+         (``shard_write:@12:kill``) — the serial must stay unpublished
+         (only the previous verified serial + a torn temp dir).
+      3. resume in the same dir — recovers from the last verified serial
+         and finishes bit-identical to the baseline.
+      4. elastic restore — the final dp=8 sharded checkpoint is loaded by
+         fresh workers on 4 virtual devices and on 1 device; loaded state
+         must be byte-equal to the baseline digest (the full-gather
+         equivalence).
+      5. watchdog — an injected in-step hang under FLAGS_step_timeout_s
+         must die as a diagnosed WatchdogTimeout within the deadline;
+         negative control: the same hang with the watchdog DISABLED must
+         still be hanging when the harness gives up waiting.
 """
 from __future__ import annotations
 
@@ -97,26 +116,144 @@ def run_worker(args) -> int:
                         exe, os.path.join(args.ckpt_dir,
                                           f"checkpoint_{done}"),
                         main, scope=scope, meta={"step": done})
-            import hashlib
-
-            digest = hashlib.sha256()
-            for name in sorted(scope.vars):
-                digest.update(name.encode())
-                digest.update(np.ascontiguousarray(
-                    np.asarray(scope.find_var(name))).tobytes())
     result = {
         "start_step": start,
         "resumed_from_serial": serial,
         "skipped_checkpoints": skipped,
         "final_step": args.total_steps,
         "final_loss": final_loss,
-        "params_sha256": digest.hexdigest(),
+        "params_sha256": _digest_scope(scope),
         "retries": monitor.metric_value("resilience_retries_total",
                                         default=0.0, site="compile"),
         "giveups": monitor.metric_value("resilience_giveups_total",
                                         default=0.0, site="compile"),
         "fallbacks": len(skipped),
     }
+    with open(args.result, "w") as f:
+        json.dump(result, f, indent=1)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# multichip worker: dp=8 ZeRO training with sharded checkpoints
+# ---------------------------------------------------------------------------
+
+MC_STEPS = 20
+MC_CKPT_EVERY = 5
+MC_KILL_SHARD_HIT = 12            # shard 4 of the 2nd checkpoint (8/save)
+MC_KILL_SERIAL = 2 * MC_CKPT_EVERY
+MC_RESUME_SERIAL = MC_KILL_SERIAL - MC_CKPT_EVERY
+
+
+def _mc_batch(step: int, dp: int = 8):
+    import numpy as np
+
+    rng = np.random.RandomState(4321 + step)
+    x = rng.rand(2 * dp, 16).astype(np.float32)
+    w = (np.arange(1, 17, dtype=np.float32).reshape(16, 1)) / 16.0
+    return {"x": x, "y": (x @ w).astype(np.float32)}
+
+
+def _mc_build():
+    import paddle_tpu as fluid
+
+    x = fluid.layers.data("x", shape=[16], dtype="float32")
+    y = fluid.layers.data("y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(x, 16)
+    pred = fluid.layers.fc(h, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    return loss
+
+
+def _digest_scope(scope):
+    import hashlib
+
+    import numpy as np
+
+    digest = hashlib.sha256()
+    for name in sorted(scope.vars):
+        digest.update(name.encode())
+        digest.update(np.ascontiguousarray(
+            np.asarray(scope.find_var(name))).tobytes())
+    return digest.hexdigest()
+
+
+def run_multichip_worker(args) -> int:
+    """One deterministic dp=8 ZeRO training run with sharded checkpoints
+    (+ the divergence sweep armed as a standing negative control)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import resilience
+
+    fluid.set_flags({"FLAGS_replica_check_interval": 5})
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        loss = _mc_build()
+        main = fluid.default_main_program()
+        startup = fluid.default_startup_program()
+        bs = fluid.BuildStrategy()
+        bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+        prog = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+        mesh = prog._mesh
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            meta, serial, skipped = resilience.load_latest_checkpoint(
+                exe, args.ckpt_dir, main_program=main, scope=scope)
+            start = int(meta.get("step", 0)) if meta else 0
+            final_loss = None
+            for step in range(start, args.total_steps):
+                (lv,) = exe.run(prog, feed=_mc_batch(step),
+                                fetch_list=[loss])
+                final_loss = float(np.asarray(lv).reshape(-1)[0])
+                done = step + 1
+                if done % args.ckpt_every == 0:
+                    fluid.io.save_checkpoint(
+                        exe, os.path.join(args.ckpt_dir,
+                                          f"checkpoint_{done}"),
+                        main, scope=scope, meta={"step": done}, mesh=mesh)
+            result = {
+                "start_step": start,
+                "resumed_from_serial": serial,
+                "skipped_checkpoints": skipped,
+                "final_step": args.total_steps,
+                "final_loss": final_loss,
+                "params_sha256": _digest_scope(scope),
+                "n_devices": len(mesh.devices.flat),
+            }
+    with open(args.result, "w") as f:
+        json.dump(result, f, indent=1)
+    return 0
+
+
+def run_verify_worker(args) -> int:
+    """Elastic-restore verifier: a fresh process (possibly with a
+    DIFFERENT device count) rebuilds the model, loads the newest verified
+    checkpoint through the recovery walk — the sharded reassembly IS the
+    full-gather restore — and digests the loaded state."""
+    import paddle_tpu as fluid
+    from paddle_tpu import resilience
+
+    import jax
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        _mc_build()
+        main = fluid.default_main_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            meta, serial, skipped = resilience.load_latest_checkpoint(
+                exe, args.ckpt_dir, main_program=main, scope=scope)
+            result = {
+                "loaded": meta is not None,
+                "serial": serial,
+                "step": int(meta.get("step", -1)) if meta else None,
+                "params_sha256": _digest_scope(scope),
+                "n_devices": jax.device_count(),
+            }
     with open(args.result, "w") as f:
         json.dump(result, f, indent=1)
     return 0
@@ -153,6 +290,191 @@ def _load(path: str):
         return None
     with open(path) as f:
         return json.load(f)
+
+
+def _spawn_mc(mode: str, ckpt_dir: str, result: str, extra_env: dict,
+              n_devices: int = 8, timeout=None):
+    """Spawn a multichip worker on ``n_devices`` virtual CPU devices.
+    Returns (rc, elapsed_s, stderr_tail); rc is None when the subprocess
+    outlived ``timeout`` and was killed (the hung-run detector)."""
+    import time
+
+    env = dict(os.environ)
+    for leak in ("FLAGS_fault_plan", "FLAGS_fault_seed",
+                 "FLAGS_retry_max_attempts", "FLAGS_retry_timeout",
+                 "FLAGS_nan_inf_policy", "FLAGS_monitor",
+                 "FLAGS_step_timeout_s", "FLAGS_replica_check_interval",
+                 "FLAGS_watchdog_hard_exit", "XLA_FLAGS"):
+        env.pop(leak, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    env["FLAGS_retry_base_delay"] = "0.01"
+    env.update(extra_env)
+    cmd = [sys.executable, os.path.abspath(__file__), f"--{mode}",
+           "--ckpt-dir", ckpt_dir, "--result", result,
+           "--total-steps", str(MC_STEPS),
+           "--ckpt-every", str(MC_CKPT_EVERY)]
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(cmd, env=env, cwd=REPO, timeout=timeout,
+                              stderr=subprocess.PIPE)
+        rc = proc.returncode
+        err = (proc.stderr or b"").decode(errors="replace")
+    except subprocess.TimeoutExpired as e:
+        rc = None
+        err = (e.stderr or b"").decode(errors="replace") \
+            if e.stderr else ""
+    # generous tail: the watchdog's whole-process stack dump runs to
+    # kilobytes and must not push earlier markers (fault_plan HANG) out
+    return rc, time.monotonic() - t0, err[-65536:]
+
+
+def run_multichip_gate(args) -> int:
+    from paddle_tpu import resilience
+
+    work = os.path.abspath(args.workdir)
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work, exist_ok=True)
+    checks = []
+    report = {"mode": "multichip", "phases": {}}
+
+    def check(name, ok, detail=""):
+        checks.append((name, bool(ok), detail))
+        print(f"  [{'ok' if ok else 'MISS'}] {name}"
+              + (f": {detail}" if detail else ""))
+
+    # -- phase 1: uninterrupted dp=8 baseline (divergence sweep armed)
+    print("== phase 1: uninterrupted dp=8 ZeRO baseline "
+          "(sharded checkpoints, FLAGS_replica_check_interval=5)")
+    rc, _, err = _spawn_mc("mc-worker", os.path.join(work, "base_ckpts"),
+                           os.path.join(work, "baseline.json"), {},
+                           timeout=240)
+    base = _load(os.path.join(work, "baseline.json"))
+    check("baseline_clean", rc == 0 and base
+          and base["final_step"] == MC_STEPS,
+          f"rc={rc}" + (f" stderr: …{err[-200:]}" if rc else ""))
+    check("divergence_sweep_stayed_silent", rc == 0 and
+          "ReplicaDivergenceError" not in err)
+    report["phases"]["baseline"] = base
+
+    # -- phase 2: kill INSIDE one shard's write of the 2nd checkpoint
+    print(f"== phase 2: kill inside shard write #{MC_KILL_SHARD_HIT} "
+          f"(checkpoint_{MC_KILL_SERIAL}, "
+          f"FLAGS_fault_plan=shard_write:@{MC_KILL_SHARD_HIT}:kill)")
+    ckpt_dir = os.path.join(work, "chaos_ckpts")
+    rc, _, _ = _spawn_mc(
+        "mc-worker", ckpt_dir, os.path.join(work, "victim.json"),
+        {"FLAGS_fault_plan": f"shard_write:@{MC_KILL_SHARD_HIT}:kill"},
+        timeout=240)
+    check("victim_killed", rc == 137, f"rc={rc} (137 = injected kill)")
+    serials = [s for s, _ in resilience.iter_serials(ckpt_dir)]
+    check("kill_left_serial_unpublished",
+          serials == [MC_RESUME_SERIAL],
+          f"published serials after kill: {serials}")
+    torn_tmp = sorted(glob.glob(
+        os.path.join(ckpt_dir, f".checkpoint_{MC_KILL_SERIAL}.tmp.*")))
+    check("torn_shard_write_is_temp_dir", len(torn_tmp) == 1,
+          f"temp dirs: {[os.path.basename(t) for t in torn_tmp]}")
+    report["phases"]["kill"] = {"rc": rc, "serials_after_kill": serials}
+
+    # -- phase 3: resume in the same dir, finish bit-identical
+    print("== phase 3: resume from the last verified sharded serial")
+    rc, _, _ = _spawn_mc("mc-worker", ckpt_dir,
+                         os.path.join(work, "resume.json"), {},
+                         timeout=240)
+    res = _load(os.path.join(work, "resume.json"))
+    report["phases"]["resume"] = {"rc": rc, "result": res}
+    check("resume_completed", rc == 0 and res
+          and res["final_step"] == MC_STEPS, f"rc={rc}")
+    if res:
+        check("resumed_from_last_verified",
+              res["resumed_from_serial"] == MC_RESUME_SERIAL,
+              f"resumed from {res['resumed_from_serial']}, want "
+              f"{MC_RESUME_SERIAL}")
+    if base and res:
+        check("final_params_bit_identical_to_baseline",
+              res["params_sha256"] == base["params_sha256"])
+
+    # -- phase 4: elastic restore of the final dp=8 checkpoint on 4 and 1
+    # devices — byte-equal to the state the baseline saved (= full gather)
+    print("== phase 4: elastic restore (dp=8 checkpoint -> 4 devices, "
+          "1 device)")
+    for n_dev in (4, 1):
+        rc, _, _ = _spawn_mc(
+            "mc-verify", os.path.join(work, "base_ckpts"),
+            os.path.join(work, f"elastic_{n_dev}.json"), {},
+            n_devices=n_dev, timeout=240)
+        ver = _load(os.path.join(work, f"elastic_{n_dev}.json"))
+        report["phases"][f"elastic_{n_dev}"] = ver
+        check(f"elastic_restore_on_{n_dev}_devices",
+              rc == 0 and ver and ver["loaded"]
+              and ver["n_devices"] == n_dev
+              and base and ver["params_sha256"] == base["params_sha256"],
+              f"rc={rc}, digest match="
+              f"{bool(base and ver and ver.get('params_sha256') == base['params_sha256'])}")
+
+    # -- phase 5: watchdog — injected hang must die diagnosed, fast
+    # generous deadline: the SAME flag also arms the compile sections, and
+    # a cold dp=8 XLA CPU compile on a loaded CI host must not trip the
+    # watchdog before the injected step hang gets its chance to fire
+    wd_timeout = 20.0
+    print(f"== phase 5: watchdog (hang:@3:hang under "
+          f"FLAGS_step_timeout_s={wd_timeout:g})")
+    rc, elapsed, err = _spawn_mc(
+        "mc-worker", os.path.join(work, "wd_ckpts"),
+        os.path.join(work, "wd.json"),
+        {"FLAGS_fault_plan": "hang:@3:hang",
+         "FLAGS_step_timeout_s": str(wd_timeout),
+         "FLAGS_watchdog_hard_exit": "1"},
+        timeout=180)
+    report["phases"]["watchdog"] = {"rc": rc, "elapsed_s": elapsed,
+                                    "stderr_tail": err[-1500:]}
+    check("watchdog_converted_hang_to_failure",
+          rc not in (0, None), f"rc={rc} after {elapsed:.1f}s")
+    # the dump must name the STEP section and the fault must actually have
+    # fired — a slow dp=8 compile tripping the deadline would otherwise
+    # fake all three checks and void the step-hang coverage
+    check("watchdog_diagnosis_dumped",
+          "section 'parallel_step'" in err and "hung section" in err,
+          "parallel_step dump present" if "hung section" in err
+          else f"stderr tail: …{err[-200:]}")
+    check("injected_hang_actually_fired",
+          "HANG at site 'hang'" in err,
+          "fault_plan hang marker in stderr")
+    # the hang fires on step 3 — well after compile — so expiry must come
+    # within the armed timeout plus scheduling slack, not a CI eternity
+    check("watchdog_fired_within_deadline", elapsed < 120,
+          f"{elapsed:.1f}s")
+
+    # negative control: the SAME hang with the watchdog disabled must
+    # still be hanging when the harness stops waiting
+    print("== phase 5b: negative control (watchdog disabled -> the run "
+          "must still be hanging at harness timeout)")
+    rc, elapsed, _ = _spawn_mc(
+        "mc-worker", os.path.join(work, "wd_neg_ckpts"),
+        os.path.join(work, "wd_neg.json"),
+        {"FLAGS_fault_plan": "hang:@3:hang",
+         "FLAGS_step_timeout_s": "0"},
+        timeout=45)
+    check("hang_without_watchdog_never_finishes", rc is None,
+          f"rc={rc} after {elapsed:.1f}s (None = killed by harness)")
+    report["phases"]["watchdog_negative"] = {"rc": rc,
+                                             "elapsed_s": elapsed}
+
+    ok = all(c[1] for c in checks)
+    report["checks"] = [{"name": n, "ok": o, "detail": d}
+                        for n, o, d in checks]
+    report["status"] = "ok" if ok else "fail"
+    print(f"chaos multichip gate: "
+          f"{len([c for c in checks if c[1]])}/{len(checks)} checks -> "
+          f"{'ok' if ok else 'FAIL'}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+        print(f"chaos multichip artifact written to {args.json}")
+    if not args.keep_workdir and ok:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0 if (not args.check or ok) else 1
 
 
 def run_gate(args) -> int:
@@ -279,11 +601,21 @@ def main(argv=None) -> int:
     ap.add_argument("--negative-control", action="store_true",
                     help="resume with retries disabled — the gate must "
                          "FAIL (proves the tripwire trips)")
-    ap.add_argument("--workdir", default=os.path.join(
-        REPO, ".chaos_check"), help="scratch dir for checkpoints/results")
+    ap.add_argument("--multichip", action="store_true",
+                    help="distributed leg: dp=8 ZeRO run with SHARDED "
+                         "checkpoints — kill inside one shard write, "
+                         "elastic 8->4->1 restore, watchdog-vs-hang "
+                         "(resilience.distributed)")
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir for checkpoints/results "
+                         "(default: .chaos_check / .chaos_check_dist)")
     ap.add_argument("--keep-workdir", action="store_true")
     # internal worker protocol
     ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--mc-worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--mc-verify", action="store_true",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--ckpt-dir", help=argparse.SUPPRESS)
     ap.add_argument("--result", help=argparse.SUPPRESS)
     ap.add_argument("--total-steps", type=int, default=TOTAL_STEPS,
@@ -291,8 +623,17 @@ def main(argv=None) -> int:
     ap.add_argument("--ckpt-every", type=int, default=CKPT_EVERY,
                     help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    if args.workdir is None:
+        args.workdir = os.path.join(
+            REPO, ".chaos_check_dist" if args.multichip else ".chaos_check")
     if args.worker:
         return run_worker(args)
+    if args.mc_worker:
+        return run_multichip_worker(args)
+    if args.mc_verify:
+        return run_verify_worker(args)
+    if args.multichip:
+        return run_multichip_gate(args)
     return run_gate(args)
 
 
