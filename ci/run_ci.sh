@@ -16,11 +16,15 @@ import paddle_tpu
 print('ops registered:', len(paddle_tpu.op_registry.all_ops()))
 print('version:', paddle_tpu.__version__)"
 
-echo "== static program linter (built-in model suite; error findings gate)"
-JAX_PLATFORMS=cpu python tools/lint_program.py --builtin
+echo "== static program lint pipeline (full pass-manager run over the model"
+echo "   zoo: verifier + PT700s/710s/720s; errors and non-allowlisted"
+echo "   dead-code findings gate; JSON report is the CI artifact)"
+JAX_PLATFORMS=cpu python tools/lint_program.py --zoo \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_lint_report.json" | tail -20
 
 echo "== op-registry conformance audit (ops without a lower rule gate)"
-JAX_PLATFORMS=cpu python tools/audit_registry.py --strict > /dev/null
+JAX_PLATFORMS=cpu python tools/audit_registry.py --strict \
+  --json-file "${CI_ARTIFACT_DIR:-.}/ci_registry_audit.json" > /dev/null
 JAX_PLATFORMS=cpu python tools/audit_registry.py --untested | tail -3
 
 echo "== peak-memory plan + PT5xx liveness gate (JSON report is the CI artifact)"

@@ -13,6 +13,14 @@ Public surface:
 * ``remat`` — Pass 6, automatic rematerialisation: memory_plan-scored
   checkpoint selection + program rebuild (``auto_recompute_program``),
   wired to the executor via ``FLAGS_auto_recompute`` (docs/PERF_NOTES.md).
+* ``pass_manager`` — the uniform IR pass framework (ROADMAP item 5):
+  ``Pass``/``PassRegistry``/``@register_pass`` with declared dependencies
+  and invalidations, ``PassContext`` analysis caching,
+  ``PassManager.run_pipeline`` with pre/post verification and per-pass
+  monitor timings. All six passes above are registered on it; the three
+  new static-analysis families (``static_checks``: PT700s dtype/shape
+  consistency, PT710s donation-race, PT720s dead-code + opt-in DCE) run
+  through it too.
 * ``CODES`` — the diagnostic-code table (see docs/ANALYSIS.md).
 """
 from .diagnostics import (CODES, Diagnostic, ProgramVerificationError,
@@ -25,6 +33,15 @@ from .liveness import (MemoryPlan, block_liveness, classify_op_effects,
 from . import remat
 from .remat import (RematCandidate, RematDecision, auto_recompute_program,
                     remat_candidates)
+from . import pass_manager
+from .pass_manager import (ALL_ANALYSIS_PASSES, VERIFY_PASSES, FunctionPass,
+                           Pass, PassContext, PassManager, PassRegistry,
+                           PassVerificationError, PipelineResult,
+                           clear_analysis_caches, default_pass_manager,
+                           get_pass_registry, register_pass,
+                           run_transform_pipeline, run_verify_pipeline)
+from . import static_checks
+from .static_checks import (DceDecision, DeadCodeReport, dce_program)
 
 __all__ = [
     "CODES", "Diagnostic", "ProgramVerificationError", "Severity",
@@ -34,4 +51,10 @@ __all__ = [
     "donation_report", "memory_plan", "safe_donation_set",
     "remat", "RematCandidate", "RematDecision", "auto_recompute_program",
     "remat_candidates",
+    "pass_manager", "Pass", "FunctionPass", "PassRegistry", "PassContext",
+    "PassManager", "PassVerificationError", "PipelineResult",
+    "register_pass", "get_pass_registry", "default_pass_manager",
+    "run_verify_pipeline", "run_transform_pipeline", "clear_analysis_caches",
+    "ALL_ANALYSIS_PASSES", "VERIFY_PASSES",
+    "static_checks", "DceDecision", "DeadCodeReport", "dce_program",
 ]

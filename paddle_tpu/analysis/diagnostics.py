@@ -70,6 +70,49 @@ CODES = {
     "PT504": (Severity.ERROR,
               "persistable var written inside a sub-block never escapes to "
               "the scope (state threading only scans the global block)"),
+    # -- pass: dtype/shape consistency (whole-program replay) ----------
+    "PT700": (Severity.ERROR,
+              "op's infer_shape fails under whole-program replay — the "
+              "producer/consumer metadata contract is broken"),
+    "PT701": (Severity.WARNING,
+              "producer/consumer shape mismatch: whole-program replay "
+              "propagates a shape a later consumer's record disagrees "
+              "with"),
+    "PT702": (Severity.WARNING,
+              "producer/consumer dtype mismatch: whole-program replay "
+              "propagates a dtype a later consumer's record disagrees "
+              "with"),
+    "PT703": (Severity.WARNING,
+              "conflicting producers: two ops write the same var with "
+              "different inferred shape/dtype"),
+    "PT704": (Severity.INFO,
+              "consumer reads a var with no recorded shape — propagation "
+              "is blind past this boundary"),
+    # -- pass: donation/alias race detector ----------------------------
+    "PT710": (Severity.INFO,
+              "donation race avoided: the state_in∩state_out heuristic "
+              "would donate the var but a later op still reads it after "
+              "its last write — the liveness proof refuses it (safe, but "
+              "costs a host copy per step)"),
+    "PT711": (Severity.WARNING,
+              "unordered double write: two ops write the var with no "
+              "data dependency or intervening read ordering them"),
+    "PT712": (Severity.WARNING,
+              "donated buffer aliased into a fetch: a fetched var is a "
+              "view of a donated var taken before its in-place update"),
+    "PT713": (Severity.WARNING,
+              "op writes a feed var in place — the fed host buffer and "
+              "the scope copy can diverge"),
+    # -- pass: dead/unreachable code lint -------------------------------
+    "PT720": (Severity.WARNING,
+              "transitively dead op: every output flows only into other "
+              "dead ops (never reaches a fetch, persistable or effect)"),
+    "PT721": (Severity.INFO,
+              "unused output: one output of an otherwise-live op is "
+              "never read, fetched or persistable"),
+    "PT722": (Severity.WARNING,
+              "unreachable sub-block: no op references the block via its "
+              "sub_block attr"),
 }
 
 

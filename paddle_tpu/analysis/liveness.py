@@ -470,7 +470,11 @@ def _global_reads(program) -> Set[str]:
 
 
 def check_liveness(program, diags: List[Diagnostic],
-                   fetch_names: Sequence[str]) -> None:
+                   fetch_names: Sequence[str],
+                   donation: Optional[tuple] = None) -> None:
+    """``donation`` lets a caller that already ran ``_donation_analysis``
+    on the global block (the registered liveness pass caches it on the
+    PassContext) hand it in instead of paying the dataflow scan twice."""
     fetch = set(fetch_names or ())
     persistable = {v.name for blk in program.blocks
                    for v in blk.vars.values() if v.persistable}
@@ -480,7 +484,8 @@ def check_liveness(program, diags: List[Diagnostic],
     # PT500 — donation-unsafe fetch: the fetched var is also updated in
     # place by the step; analyze_block_io now refuses to donate it, and the
     # finding explains the (silent) conservatism.
-    cands, unsafe, live = _donation_analysis(gb, feeds, fetch)
+    cands, unsafe, live = donation if donation is not None \
+        else _donation_analysis(gb, feeds, fetch)
     for n in sorted(cands & fetch):
         ld = live[n].last_def
         op = gb.ops[ld] if ld is not None else None

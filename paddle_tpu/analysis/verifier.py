@@ -418,16 +418,20 @@ def verify_program(program, fetch_names: Sequence[str] = (),
     """Run the static verifier; returns all findings (never raises).
 
     ``fetch_names`` suppresses PT203 for vars the caller will fetch.
+
+    Since the pass-manager refactor this routes through
+    ``PassManager.run_pipeline`` over the default ``PassRegistry`` — any
+    registered analysis pass name (including the PT700s/710s/720s families
+    and custom ``@register_pass`` passes) is accepted, each run lands
+    ``pass_runs_total``/``pass_duration_seconds`` on the monitor registry,
+    and passes sharing a dependency (liveness) compute it once. Raises
+    ``KeyError`` on an unknown pass name.
     """
-    diags: List[Diagnostic] = []
-    fetch = set(fetch_names or ())
-    for name in passes:
-        fn = _PASS_FNS.get(name)
-        if fn is None:
-            raise KeyError(f"unknown verifier pass '{name}' — known: "
-                           f"{sorted(_PASS_FNS)}")
-        fn(program, diags, fetch)
-    return diags
+    from .pass_manager import default_pass_manager
+
+    result = default_pass_manager().run_pipeline(
+        program, passes, fetch_names=fetch_names, verify="none")
+    return list(result.diagnostics)
 
 
 def check_program(program, fetch_names: Sequence[str] = (),

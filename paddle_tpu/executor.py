@@ -492,8 +492,7 @@ class Executor:
         cached = self._remat_cache.get(key)
         if cached is not None:
             return cached
-        from .analysis.remat import (auto_recompute_program,
-                                     is_trainable_program)
+        from .analysis.remat import is_trainable_program
 
         # startup/inference programs cannot remat by construction; pass
         # through (cached) with no monitor record — a 'refused' count here
@@ -501,10 +500,17 @@ class Executor:
         if not is_trainable_program(program):
             self._remat_cache[key] = program
             return program
-        decision = auto_recompute_program(
-            program, feed_names=sorted(feed or {}),
-            fetch_names=list(fetch_names or ()),
-            batch_size=batch, budget_mb=budget)
+        # the transform runs as a registered pass through the manager
+        # (ROADMAP item 5): at FLAGS_check_program>=2 the pipeline
+        # re-verifies the rebuilt program and refuses a corrupting
+        # transform with PassVerificationError
+        from .analysis.pass_manager import run_transform_pipeline
+
+        result = run_transform_pipeline(
+            program, ("auto_remat",), feed_names=sorted(feed or {}),
+            fetch_names=list(fetch_names or ()), batch_size=batch,
+            options={"budget_mb": budget})
+        decision = result.values["auto_remat"]
         _monitor.record_remat(decision)
         self._remat_cache[key] = decision.program
         return decision.program
@@ -513,17 +519,19 @@ class Executor:
         """FLAGS_check_program pre-run hook: static-verify each program
         version once before it compiles (the build-time role of the
         reference's op_registry.h checks). Raises ProgramVerificationError
-        with build-site diagnostics on error-severity findings."""
+        with build-site diagnostics on error-severity findings. Runs the
+        verifier passes through ``PassManager.run_pipeline`` (ROADMAP item
+        5), so per-pass timings land on the monitor registry."""
         from .flags import flag
 
-        if not flag("check_program"):
+        if not int(flag("check_program")):
             return
         fp = self._program_fingerprint(program)
         if fp in self._verified:
             return
-        from .analysis import check_program
+        from .analysis.pass_manager import run_verify_pipeline
 
-        check_program(program, fetch_names=fetch_names)
+        run_verify_pipeline(program, fetch_names=fetch_names)
         self._verified.add(fp)
 
     # -- public API ------------------------------------------------------

@@ -18,12 +18,17 @@ _DEFS: Dict[str, tuple] = {
     "check_nan_inf": (bool, False,
                       "per-op finite checks with op provenance on failure "
                       "(reference flags.cc:44; operator.cc fast_check_nan_inf)"),
-    "check_program": (bool, False,
-                      "static-verify programs before first execution "
-                      "(paddle_tpu.analysis.check_program; error-severity "
-                      "findings raise ProgramVerificationError with the op's "
-                      "build site — see docs/ANALYSIS.md). On by default in "
-                      "the test suite via tests/conftest.py"),
+    "check_program": (int, 0,
+                      "static-verification level (paddle_tpu.analysis): "
+                      "0 off; 1 verify each program once before first "
+                      "execution (error-severity findings raise "
+                      "ProgramVerificationError with the op's build site); "
+                      "2 additionally re-run verify_program after every "
+                      "transform pass in a PassManager pipeline — a "
+                      "transform introducing new errors is refused with "
+                      "PassVerificationError naming the pass. See "
+                      "docs/ANALYSIS.md. Level 1 is on by default in the "
+                      "test suite via tests/conftest.py"),
     "monitor": (bool, True,
                 "runtime metrics collection (paddle_tpu.monitor): executor "
                 "counters/histograms, step hooks, recompilation diagnostics "

@@ -157,9 +157,16 @@ def build_bert_pretrain(cfg: BertConfig = None, seq_len: int = 128,
             layers.not_equal(layers.unsqueeze(mask_label, [2]),
                              layers.fill_constant([1], "int64", -100)),
             "float32")
+        # the masked-token count is a label statistic, not a differentiable
+        # quantity: fence it so append_backward doesn't emit a dead grad
+        # chain (max_grad/reduce_sum_grad with no consumer — PT720)
+        is_masked.stop_gradient = True
+        masked_count = layers.reduce_sum(is_masked)
+        masked_count.stop_gradient = True
         denom = layers.elementwise_max(
-            layers.reduce_sum(is_masked),
+            masked_count,
             layers.fill_constant([1], "float32", 1.0))
+        denom.stop_gradient = True
         mlm_loss = layers.elementwise_div(layers.reduce_sum(mlm_loss), denom)
 
         # -- next-sentence head on [CLS]

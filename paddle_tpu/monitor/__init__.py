@@ -50,7 +50,8 @@ __all__ = [
     "add_hook", "remove_hook", "clear_hooks", "get_registry", "counter",
     "gauge", "histogram", "metric_value", "enabled", "record_cache_lookup",
     "observe_compile", "complete_compile", "step_begin", "step_end",
-    "record_remat", "record_watchdog_timeout", "recompile_events",
+    "record_pass", "record_remat", "record_watchdog_timeout",
+    "recompile_events",
     "recompile_count", "snapshot", "reset", "get_tracker", "build_site",
 ]
 
@@ -186,6 +187,25 @@ def record_watchdog_timeout(section: str) -> None:
     counter("watchdog_timeouts_total",
             "watchdog deadlines that expired (hangs converted to "
             "diagnosed failures)").labels(section=section).inc()
+
+
+def record_pass(name: str, kind: str, seconds: float,
+                cached: bool = False) -> None:
+    """Account one IR-pass execution (analysis.pass_manager): run counts by
+    pass/kind/result (``cached`` = the PassContext served the analysis from
+    its cache) and wall-time histograms for real runs — the per-pass
+    timings the ROADMAP item 5 refactor promised (docs/OBSERVABILITY.md)."""
+    if not enabled():
+        return
+    counter("pass_runs_total",
+            "IR pass executions by pass, kind and result (result=cached "
+            "means the PassContext analysis cache was hit)").labels(
+        **{"pass": name, "kind": kind,
+           "result": "cached" if cached else "run"}).inc()
+    if not cached:
+        histogram("pass_duration_seconds",
+                  "wall time of one IR pass execution, by pass").labels(
+            **{"pass": name}).observe(seconds)
 
 
 def record_remat(decision) -> None:

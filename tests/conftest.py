@@ -53,6 +53,22 @@ def _no_global_clip_leak():
     clip._clip_attr["__global__"] = None
 
 
+@pytest.fixture(autouse=True)
+def _pass_registry_isolation():
+    """The analysis PassRegistry is process-global (like the flags and the
+    clip attr above): a test registering a custom pass, or overriding a
+    built-in, must not leak it into the rest of the suite. Snapshot the
+    registration table before each test, restore it after, and drop any
+    shared PassContext analysis caches."""
+    from paddle_tpu.analysis import pass_manager as pm
+
+    reg = pm.get_pass_registry()
+    snap = reg.snapshot()
+    yield
+    reg.restore(snap)
+    pm.clear_analysis_caches()
+
+
 def pytest_collection_modifyitems(config, items):
     import pytest
 

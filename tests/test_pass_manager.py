@@ -1,0 +1,761 @@
+"""paddle_tpu.analysis.pass_manager — the uniform IR pass framework
+(ROADMAP item 5): registry round-trips, dependency ordering, analysis-cache
+reuse vs invalidation-after-transform, the pre/post verification bracket,
+the PT700s/PT710s/PT720s static-check families (positive + negative
+controls each), the opt-in DCE transform's fidelity witness, and the
+executor hooks routing through the manager."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.unique_name as un
+from paddle_tpu import monitor
+from paddle_tpu.analysis import (ALL_ANALYSIS_PASSES, VERIFY_PASSES,
+                                 PassContext, PassManager,
+                                 PassVerificationError,
+                                 ProgramVerificationError, Severity,
+                                 check_program, dce_program,
+                                 default_pass_manager, get_pass_registry,
+                                 register_pass, verify_program)
+from paddle_tpu.analysis.pass_manager import ANALYSIS, TRANSFORM
+from paddle_tpu.core import registry as op_registry
+
+
+def codes_of(diags):
+    return {d.code for d in diags}
+
+
+def run_passes(prog, passes, fetches=(), feeds=(), verify="none"):
+    return default_pass_manager().run_pipeline(
+        prog, passes, feed_names=list(feeds), fetch_names=list(fetches),
+        verify=verify)
+
+
+def _mlp_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with un.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+# ---------------------------------------------------------------------------
+# registry: round-trip, duplicates, isolation
+# ---------------------------------------------------------------------------
+
+def test_builtin_passes_registered():
+    names = get_pass_registry().names()
+    for n in ALL_ANALYSIS_PASSES + ("auto_remat", "dce"):
+        assert n in names, n
+    assert tuple(VERIFY_PASSES) == tuple(
+        fluid.analysis.DEFAULT_PASSES)  # the pre-manager pipeline survives
+
+
+def test_register_custom_pass_roundtrip():
+    seen = []
+
+    @register_pass("pm_test_custom")
+    def my_pass(program, ctx):
+        seen.append(sum(len(b.ops) for b in program.blocks))
+        return "custom-result"
+
+    assert get_pass_registry().has("pm_test_custom")
+    main, _, loss = _mlp_program()
+    res = run_passes(main, ("pm_test_custom",), fetches=[loss.name])
+    assert res.values["pm_test_custom"] == "custom-result"
+    assert seen and seen[0] > 0
+    # verify_program accepts registered custom pass names too
+    verify_program(main, fetch_names=[loss.name],
+                   passes=("schema", "pm_test_custom"))
+    assert len(seen) == 2
+
+
+def test_registry_snapshot_restore_drops_custom_pass():
+    reg = get_pass_registry()
+    snap = reg.snapshot()
+
+    @register_pass("pm_test_leaky")
+    def leaky(program, ctx):
+        return None
+
+    assert reg.has("pm_test_leaky")
+    reg.restore(snap)
+    assert not reg.has("pm_test_leaky")
+    assert reg.has("schema")  # builtins survive the restore
+
+
+def test_duplicate_registration_rejected_override_allowed():
+    @register_pass("pm_test_dup")
+    def first(program, ctx):
+        return 1
+
+    with pytest.raises(ValueError, match="already registered"):
+        @register_pass("pm_test_dup")
+        def second(program, ctx):
+            return 2
+
+    @register_pass("pm_test_dup", override=True)
+    def third(program, ctx):
+        return 3
+
+    main, _, loss = _mlp_program()
+    assert run_passes(main, ("pm_test_dup",)).values["pm_test_dup"] == 3
+
+
+def test_unknown_pass_raises_keyerror():
+    main, _, _ = _mlp_program()
+    with pytest.raises(KeyError, match="unknown pass"):
+        run_passes(main, ("definitely_not_registered",))
+    with pytest.raises(KeyError):
+        verify_program(main, passes=("nope",))
+
+
+# ---------------------------------------------------------------------------
+# dependency ordering + cycles
+# ---------------------------------------------------------------------------
+
+def test_dependency_ordering():
+    order = []
+
+    @register_pass("pm_test_base")
+    def base(program, ctx):
+        order.append("base")
+
+    @register_pass("pm_test_mid", requires=("pm_test_base",))
+    def mid(program, ctx):
+        order.append("mid")
+
+    @register_pass("pm_test_top", requires=("pm_test_mid",))
+    def top(program, ctx):
+        order.append("top")
+
+    main, _, _ = _mlp_program()
+    mgr = default_pass_manager()
+    # requesting only the top pass pulls the chain in dependency order
+    assert mgr.resolve(("pm_test_top",)) == [
+        "pm_test_base", "pm_test_mid", "pm_test_top"]
+    run_passes(main, ("pm_test_top",))
+    assert order == ["base", "mid", "top"]
+    # builtin deps: donation_race pulls liveness ahead of itself
+    r = mgr.resolve(("donation_race",))
+    assert r.index("liveness") < r.index("donation_race")
+
+
+def test_dependency_cycle_detected():
+    @register_pass("pm_test_cyc_a", requires=("pm_test_cyc_b",))
+    def a(program, ctx):
+        pass
+
+    @register_pass("pm_test_cyc_b", requires=("pm_test_cyc_a",))
+    def b(program, ctx):
+        pass
+
+    main, _, _ = _mlp_program()
+    with pytest.raises(ValueError, match="cycle"):
+        run_passes(main, ("pm_test_cyc_a",))
+
+
+# ---------------------------------------------------------------------------
+# analysis cache: shared across passes, dropped by transforms
+# ---------------------------------------------------------------------------
+
+def test_analysis_cache_shared_across_dependents():
+    calls = []
+
+    @register_pass("pm_test_count")
+    def count(program, ctx):
+        calls.append(1)
+        return len(calls)
+
+    @register_pass("pm_test_dep1", requires=("pm_test_count",))
+    def dep1(program, ctx):
+        return ctx.analysis("pm_test_count")
+
+    @register_pass("pm_test_dep2", requires=("pm_test_count",))
+    def dep2(program, ctx):
+        return ctx.analysis("pm_test_count")
+
+    main, _, loss = _mlp_program()
+    res = run_passes(main, ("pm_test_dep1", "pm_test_dep2"),
+                     fetches=[loss.name])
+    assert len(calls) == 1  # one shared run serves both dependents
+    assert res.values["pm_test_dep1"] == res.values["pm_test_dep2"] == 1
+
+
+def test_transform_invalidates_analysis_cache():
+    calls = []
+
+    @register_pass("pm_test_count2")
+    def count(program, ctx):
+        calls.append(1)
+        return len(calls)
+
+    @register_pass("pm_test_clone", kind=TRANSFORM)
+    def clone_t(program, ctx):
+        return program.clone()
+
+    @register_pass("pm_test_after", requires=("pm_test_count2",))
+    def after(program, ctx):
+        return ctx.analysis("pm_test_count2")
+
+    main, _, loss = _mlp_program()
+    res = run_passes(main, ("pm_test_count2", "pm_test_clone",
+                            "pm_test_after"), fetches=[loss.name])
+    # the transform swapped the program -> the cached analysis was dropped
+    # and recomputed on the rebuilt program
+    assert len(calls) == 2
+    assert res.changed and res.program is not main
+
+
+def test_transform_with_narrow_invalidation_keeps_other_analyses():
+    calls = []
+
+    @register_pass("pm_test_count3")
+    def count(program, ctx):
+        calls.append(1)
+        return len(calls)
+
+    @register_pass("pm_test_clone2", kind=TRANSFORM,
+                   invalidates=("something_else",))
+    def clone_t(program, ctx):
+        return program.clone()
+
+    @register_pass("pm_test_after3", requires=("pm_test_count3",))
+    def after(program, ctx):
+        return ctx.analysis("pm_test_count3")
+
+    main, _, loss = _mlp_program()
+    run_passes(main, ("pm_test_count3", "pm_test_clone2",
+                      "pm_test_after3"), fetches=[loss.name])
+    assert len(calls) == 1  # declared invalidation spared the cache
+
+
+# ---------------------------------------------------------------------------
+# pre/post verification: the pipeline invariant
+# ---------------------------------------------------------------------------
+
+def _register_corrupting_pass(name="pm_test_corrupt"):
+    @register_pass(name, kind=TRANSFORM)
+    def corrupt(program, ctx):
+        p = program.clone()
+        op = next(o for o in p.global_block.ops if o.type == "relu")
+        del op.inputs["X"]  # PT101: required input slot now absent
+        return p
+
+    return name
+
+
+def test_strict_verify_catches_corrupting_transform():
+    main, _, loss = _mlp_program()
+    name = _register_corrupting_pass()
+    with pytest.raises(PassVerificationError) as ei:
+        run_passes(main, (name,), fetches=[loss.name], verify="strict")
+    assert ei.value.pass_name == name
+    assert "PT101" in str(ei.value)
+    # PassVerificationError is a ProgramVerificationError: existing
+    # callers' except clauses keep working
+    assert isinstance(ei.value, ProgramVerificationError)
+    # without the bracket the corrupt program sails through
+    res = run_passes(main, (name,), fetches=[loss.name], verify="none")
+    assert res.changed
+
+
+def test_check_program_level2_gates_transform_pipelines():
+    from paddle_tpu.analysis.pass_manager import run_transform_pipeline
+
+    main, _, loss = _mlp_program()
+    name = _register_corrupting_pass("pm_test_corrupt2")
+    prev = fluid.get_flags(["FLAGS_check_program"])
+    fluid.set_flags({"FLAGS_check_program": 2})
+    try:
+        with pytest.raises(PassVerificationError):
+            run_transform_pipeline(main, (name,), fetch_names=[loss.name])
+        # level 1: pre-run verification only, no transform bracket
+        fluid.set_flags({"FLAGS_check_program": 1})
+        res = run_transform_pipeline(main, (name,),
+                                     fetch_names=[loss.name])
+        assert res.changed
+    finally:
+        fluid.set_flags(prev)
+
+
+def test_strict_verify_survives_op_renumbering():
+    """Pre-existing errors whose MESSAGES embed op indices (PT200's
+    'produced later (op N)') must not look new after a transform merely
+    shifts indices — the baseline compares per-code counts."""
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        blk = p.global_block
+        blk.create_var(name="late", shape=[2], dtype="float32")
+        early = fluid.layers.scale(blk.var("late"), scale=1.0)  # PT200
+        blk.append_op("fill_constant", outputs={"Out": ["late"]},
+                      attrs={"shape": [2], "dtype": "float32",
+                             "value": 1.0})
+
+    @register_pass("pm_test_prepend", kind=TRANSFORM)
+    def prepend(program, ctx):
+        q = program.clone()
+        q.global_block.create_var(name="pm_pad", shape=[1],
+                                  dtype="float32")
+        q.global_block.insert_op(
+            0, "fill_constant", outputs={"Out": ["pm_pad"]},
+            attrs={"shape": [1], "dtype": "float32", "value": 0.0})
+        return q
+
+    # the PT200 error pre-dates the pass and its message now names a
+    # different op index — still not the transform's fault
+    res = run_passes(p, ("pm_test_prepend",), fetches=[early.name],
+                     verify="strict")
+    assert res.changed
+
+
+def test_on_demand_analysis_diagnostics_not_duplicated():
+    """A pass calling ctx.analysis() for an undeclared dependency must not
+    double-count that analysis' findings when the pipeline also lists it."""
+    @register_pass("pm_test_peek")
+    def peek(program, ctx):
+        return ctx.analysis("dead_code")  # on-demand, no requires=
+
+    p, a, b, out = _dead_chain_program()
+    res = run_passes(p, ("pm_test_peek", "dead_code"), fetches=[out.name])
+    assert sum(d.code == "PT720" for d in res.diagnostics) == 2  # not 4
+
+
+def test_strict_verify_ignores_preexisting_errors():
+    """The bracket flags NEW errors only: a program already carrying an
+    error finding may still run a transform that leaves it untouched."""
+    main, _, loss = _mlp_program()
+    op = next(o for o in main.global_block.ops if o.type == "mean")
+    del op.inputs["X"]  # pre-existing PT101
+
+    @register_pass("pm_test_noop_t", kind=TRANSFORM)
+    def noop(program, ctx):
+        return program.clone()
+
+    res = run_passes(main, ("pm_test_noop_t",), fetches=[loss.name],
+                     verify="strict")
+    assert res.changed  # the old error did not blame the innocent pass
+
+
+# ---------------------------------------------------------------------------
+# the migrated pipeline: identical diagnostics, monitor timings
+# ---------------------------------------------------------------------------
+
+def test_verify_pipeline_matches_check_program():
+    main, _, loss = _mlp_program()
+    op = next(o for o in main.global_block.ops if o.type == "relu")
+    del op.inputs["X"]
+    from paddle_tpu.analysis.pass_manager import run_verify_pipeline
+
+    with pytest.raises(ProgramVerificationError) as e1:
+        check_program(main, fetch_names=[loss.name])
+    with pytest.raises(ProgramVerificationError) as e2:
+        run_verify_pipeline(main, fetch_names=[loss.name])
+    assert ([d.code for d in e1.value.diagnostics]
+            == [d.code for d in e2.value.diagnostics])
+
+
+def test_executor_hook_routes_through_manager():
+    """FLAGS_check_program executions show up as per-pass monitor
+    counters/timings — the acceptance-visible face of the migration."""
+    def runs(name):
+        return monitor.metric_value(
+            "pass_runs_total", 0.0,
+            **{"pass": name, "kind": "analysis", "result": "run"})
+
+    before = {n: runs(n) for n in VERIFY_PASSES}
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    fluid.set_flags({"FLAGS_check_program": 1})
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.zeros((2, 4), np.float32),
+                            "y": np.zeros((2, 1), np.float32)},
+                fetch_list=[loss.name])
+    for n in VERIFY_PASSES:
+        assert runs(n) > before[n], n
+    hist = monitor.metric_value("pass_duration_seconds", None,
+                                **{"pass": "liveness"})
+    assert hist is not None and hist["count"] > 0
+    # and the JSON export carries them (the CI artifact face)
+    snap = monitor.snapshot()
+    assert "pass_runs_total" in snap["metrics"]
+    assert "pass_duration_seconds" in snap["metrics"]
+
+
+def test_auto_remat_via_transform_pipeline():
+    """The FLAGS_auto_recompute executor path now runs Pass 6 through the
+    manager; the pipeline result carries the RematDecision."""
+    from paddle_tpu.analysis.pass_manager import run_transform_pipeline
+
+    main, startup = fluid.Program(), fluid.Program()
+    with un.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = x
+        for _ in range(6):
+            h = fluid.layers.fc(h, 16, act="relu")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(h, 1), y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    res = run_transform_pipeline(main, ("auto_remat",),
+                                 feed_names=["x", "y"],
+                                 fetch_names=[loss.name], batch_size=8)
+    dec = res.values["auto_remat"]
+    assert dec.applied and dec.n_segments > 0
+    assert res.program is dec.program and res.changed
+    assert any(op.type == "recompute_segment"
+               for op in res.program.global_block.ops)
+
+
+# ---------------------------------------------------------------------------
+# PT700s — whole-program dtype/shape consistency
+# ---------------------------------------------------------------------------
+
+def _clean_chain():
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.relu(x)
+        out = fluid.layers.scale(h, scale=2.0)
+    return p, h, out
+
+
+def test_pt700_infer_failure_under_propagation():
+    if not op_registry.has_op("pm_strict_infer"):
+        def strict_infer(op, block):
+            v = block.var(op.inputs["X"][0])
+            if v.shape is not None and tuple(v.shape)[-1] != 4:
+                raise ValueError(f"pm_strict_infer wants last dim 4, "
+                                 f"got {v.shape}")
+            block.var(op.outputs["Out"][0]).shape = v.shape
+
+        op_registry._OP_REGISTRY["pm_strict_infer"] = op_registry.OpDef(
+            type="pm_strict_infer",
+            inputs=[op_registry.IOSpec("X")],
+            outputs=[op_registry.IOSpec("Out")],
+            infer_shape=strict_infer, lower=lambda ctx, ins, attrs: None)
+    p, h, out = _clean_chain()
+    blk = p.global_block
+    o = blk.create_var(name="pm_strict_out", shape=[4], dtype="float32")
+    blk.append_op("pm_strict_infer", inputs={"X": [h.name]},
+                  outputs={"Out": [o.name]})
+    # negative control first: consistent metadata, no PT700
+    res = run_passes(p, ("dtype_shape_check",), fetches=[out.name])
+    assert "PT700" not in codes_of(res.diagnostics)
+    # upstream producer drifts -> propagation hands the consumer a shape
+    # its contract rejects
+    op = next(o_ for o_ in blk.ops if o_.type == "relu")
+    op.attrs["__pm_poke__"] = 1  # raw mutate: no re-infer
+    blk.var(h.name).shape = (2, 9)
+    res = run_passes(p, ("dtype_shape_check",), fetches=[out.name])
+    assert "PT700" not in codes_of(res.diagnostics)  # recorded = replayed
+    # force replay drift: relu's input metadata changes, its replay output
+    # follows, and the strict consumer downstream blows up
+    blk.var("x").shape = (-1, 9)
+    blk.var(h.name).shape = (-1, 4)
+    res = run_passes(p, ("dtype_shape_check",), fetches=[out.name])
+    assert "PT700" in codes_of(res.diagnostics)
+
+
+def test_pt701_shape_mismatch_at_consumer_boundary():
+    p, h, out = _clean_chain()
+    p.global_block.var(h.name).shape = (9, 9)  # stale recorded metadata
+    res = run_passes(p, ("dtype_shape_check",), fetches=[out.name])
+    found = [d for d in res.diagnostics if d.code == "PT701"]
+    assert found and "scale" in found[0].message  # consumer named
+
+
+def test_pt702_dtype_mismatch_at_consumer_boundary():
+    p, h, out = _clean_chain()
+    p.global_block.var(h.name).dtype = "int64"
+    res = run_passes(p, ("dtype_shape_check",), fetches=[out.name])
+    assert "PT702" in codes_of(res.diagnostics)
+
+
+def test_pt703_conflicting_producers():
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        blk = p.global_block
+        blk.create_var(name="v", shape=[2], dtype="float32")
+        blk.append_op("fill_constant", outputs={"Out": ["v"]},
+                      attrs={"shape": [2], "dtype": "float32", "value": 1.0})
+        blk.append_op("fill_constant", outputs={"Out": ["v"]},
+                      attrs={"shape": [3], "dtype": "int64", "value": 2.0})
+        out = fluid.layers.scale(blk.var("v"), scale=1.0)
+    res = run_passes(p, ("dtype_shape_check",), fetches=[out.name])
+    assert "PT703" in codes_of(res.diagnostics)
+
+
+def test_pt704_shapeless_consumer_boundary():
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        blk = p.global_block
+        u = blk.create_var(name="u", shape=None, dtype="float32")
+        out = fluid.layers.relu(u)
+    res = run_passes(p, ("dtype_shape_check",), fetches=[out.name])
+    assert "PT704" in codes_of(res.diagnostics)
+
+
+def test_pt700s_negative_control_clean_program():
+    main, startup, loss = _mlp_program()
+    for prog, fetches in ((main, [loss.name]), (startup, [])):
+        res = run_passes(prog, ("dtype_shape_check",), fetches=fetches)
+        assert not res.diagnostics, [str(d) for d in res.diagnostics]
+    # and the pass is read-only: metadata restored after the replay
+    assert main.global_block.var("x").shape == (-1, 4)
+
+
+# ---------------------------------------------------------------------------
+# PT710s — donation/alias races
+# ---------------------------------------------------------------------------
+
+def _donation_race_program(read_after_write=True):
+    """Persistable w is read into the step, updated in place, and (for the
+    positive control) read AGAIN after the update — the shape the old
+    state_in∩state_out heuristic donated and the PR 2 proof refuses."""
+    p, sp = fluid.Program(), fluid.Program()
+    with un.guard(), fluid.program_guard(p, sp):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        w = p.global_block.create_parameter("w_state", [4], "float32")
+        h = fluid.layers.elementwise_add(x, w)          # read w
+        fluid.layers.assign(h, output=w)                # write w in place
+        if read_after_write:
+            out = fluid.layers.scale(w, scale=1.0)      # read AFTER write
+        else:
+            out = fluid.layers.scale(h, scale=1.0)
+    return p, out
+
+
+def test_pt710_donated_then_read_race():
+    p, out = _donation_race_program(read_after_write=True)
+    res = run_passes(p, ("donation_race",), fetches=[out.name],
+                     feeds=["x"])
+    found = [d for d in res.diagnostics if d.code == "PT710"]
+    assert found and "w_state" in found[0].message
+
+
+def test_pt710_negative_control():
+    p, out = _donation_race_program(read_after_write=False)
+    res = run_passes(p, ("donation_race",), fetches=[out.name],
+                     feeds=["x"])
+    assert "PT710" not in codes_of(res.diagnostics)
+
+
+def test_pt711_unordered_double_write():
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        blk = p.global_block
+        blk.create_var(name="v", shape=[2], dtype="float32")
+        blk.append_op("fill_constant", outputs={"Out": ["v"]},
+                      attrs={"shape": [2], "dtype": "float32", "value": 1.0})
+        blk.append_op("fill_constant", outputs={"Out": ["v"]},
+                      attrs={"shape": [2], "dtype": "float32", "value": 2.0})
+        out = fluid.layers.scale(blk.var("v"), scale=1.0)
+    res = run_passes(p, ("donation_race",), fetches=[out.name])
+    assert "PT711" in codes_of(res.diagnostics)
+
+
+def test_pt711_negative_intervening_read_orders_writes():
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        blk = p.global_block
+        blk.create_var(name="v", shape=[2], dtype="float32")
+        blk.append_op("fill_constant", outputs={"Out": ["v"]},
+                      attrs={"shape": [2], "dtype": "float32", "value": 1.0})
+        mid = fluid.layers.scale(blk.var("v"), scale=1.0)  # read orders
+        blk.append_op("fill_constant", outputs={"Out": ["v"]},
+                      attrs={"shape": [2], "dtype": "float32", "value": 2.0})
+        out = fluid.layers.elementwise_add(mid, blk.var("v"))
+    res = run_passes(p, ("donation_race",), fetches=[out.name])
+    assert "PT711" not in codes_of(res.diagnostics)
+
+
+def _alias_fetch_program(view_before_update=True):
+    p, sp = fluid.Program(), fluid.Program()
+    with un.guard(), fluid.program_guard(p, sp):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        w = p.global_block.create_parameter("w_al", [4], "float32")
+        h = fluid.layers.elementwise_add(x, w)          # read w
+        if view_before_update:
+            snap = fluid.layers.assign(w)               # view BEFORE update
+            fluid.layers.assign(h, output=w)            # in-place update
+        else:
+            fluid.layers.assign(h, output=w)
+            snap = fluid.layers.assign(w)               # view after: fine
+    return p, snap
+
+
+def test_pt712_fetch_views_donated_buffer():
+    p, snap = _alias_fetch_program(view_before_update=True)
+    res = run_passes(p, ("donation_race",), fetches=[snap.name],
+                     feeds=["x"])
+    found = [d for d in res.diagnostics if d.code == "PT712"]
+    assert found and "w_al" in found[0].message
+
+
+def test_pt712_negative_view_after_final_write():
+    p, snap = _alias_fetch_program(view_before_update=False)
+    res = run_passes(p, ("donation_race",), fetches=[snap.name],
+                     feeds=["x"])
+    assert "PT712" not in codes_of(res.diagnostics)
+
+
+def test_pt713_write_to_feed_var():
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        blk = p.global_block
+        blk.append_op("scale", inputs={"X": [x.name]},
+                      outputs={"Out": [x.name]}, attrs={"scale": 2.0})
+        out = fluid.layers.relu(x)
+    res = run_passes(p, ("donation_race",), fetches=[out.name],
+                     feeds=["x"])
+    assert "PT713" in codes_of(res.diagnostics)
+
+
+def test_pt710s_negative_control_clean_training_program():
+    main, _, loss = _mlp_program()
+    res = run_passes(main, ("donation_race",), fetches=[loss.name],
+                     feeds=["x", "y"])
+    bad = {d.code for d in res.diagnostics} & {"PT711", "PT712", "PT713"}
+    assert not bad, [str(d) for d in res.diagnostics]
+
+
+# ---------------------------------------------------------------------------
+# PT720s — dead code lint + DCE
+# ---------------------------------------------------------------------------
+
+def _dead_chain_program():
+    """h is live; a=scale(h) is read ONLY by b=scale(a); b is read by
+    nobody — a is dead only transitively (first-order PT502 misses it)."""
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.relu(x)
+        a = fluid.layers.scale(h, scale=2.0)
+        b = fluid.layers.scale(a, scale=3.0)
+        out = fluid.layers.scale(h, scale=4.0)
+    return p, a, b, out
+
+
+def test_pt720_transitive_dead_chain():
+    p, a, b, out = _dead_chain_program()
+    res = run_passes(p, ("dead_code", "liveness"), fetches=[out.name])
+    dead_msgs = [d for d in res.diagnostics if d.code == "PT720"]
+    assert len(dead_msgs) == 2  # BOTH links of the chain
+    # ...while first-order PT502 sees only the chain's tail (a IS read,
+    # by the dead b) — the closure is the new information
+    pt502_ops = {d.op_idx for d in res.diagnostics if d.code == "PT502"}
+    pt720_ops = {d.op_idx for d in res.diagnostics if d.code == "PT720"}
+    assert pt720_ops > pt502_ops
+
+
+def test_pt720_negative_control():
+    main, _, loss = _mlp_program()
+    res = run_passes(main, ("dead_code",), fetches=[loss.name])
+    assert "PT720" not in codes_of(res.diagnostics)
+
+
+def test_pt721_unused_output_of_live_op():
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        acc = fluid.layers.accuracy(fluid.layers.fc(x, 4), label)
+    res = run_passes(p, ("dead_code",), fetches=[acc.name])
+    found = [d for d in res.diagnostics if d.code == "PT721"]
+    # accuracy's Correct/Total state outputs are unused; the op is live
+    assert found and all(d.op_type == "accuracy" for d in found)
+    assert "PT720" not in codes_of(res.diagnostics)
+
+
+def test_pt722_unreachable_sub_block():
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        out = fluid.layers.relu(x)
+    p._create_block()   # orphan: no op carries sub_block=1
+    p._rollback()
+    res = run_passes(p, ("dead_code",), fetches=[out.name])
+    assert "PT722" in codes_of(res.diagnostics)
+
+
+def test_dce_removes_dead_chain_and_preserves_results():
+    p, a, b, out = _dead_chain_program()
+    n0 = len(p.global_block.ops)
+    res = run_passes(p, ("dce",), fetches=[out.name], verify="strict")
+    dec = res.values["dce"]
+    assert dec.applied and dec.removed_ops == 2
+    assert len(res.program.global_block.ops) == n0 - 2
+    assert {a.name, b.name} & set(res.program.global_block.vars) == set()
+    # the witness: identical fetches from the original and DCE'd program
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": np.arange(8, dtype=np.float32).reshape(2, 4)}
+    with fluid.scope_guard(fluid.Scope()):
+        (want,) = exe.run(p, feed=feed, fetch_list=[out.name])
+    with fluid.scope_guard(fluid.Scope()):
+        (got,) = exe.run(res.program, feed=feed, fetch_list=[out.name])
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_dce_refuses_on_clean_program():
+    main, _, loss = _mlp_program()
+    dec = dce_program(main, fetch_names=[loss.name])
+    assert not dec.applied and dec.program is main
+    assert "no dead ops" in dec.reason
+
+
+def test_dce_never_removes_effectful_or_fetched_ops():
+    if not op_registry.has_op("py_func"):
+        # 'py_func' is in liveness._SIDE_EFFECT_TYPES: registering a stub
+        # gives the test a schema-valid op the effect classifier pins
+        op_registry.register_op("py_func", inputs=["X"], outputs=["Out"],
+                                grad=None)(lambda ctx, ins, attrs: None)
+    p = fluid.Program()
+    with un.guard(), fluid.program_guard(p, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.relu(x)          # fetched
+        d = fluid.layers.scale(h, scale=2.0)  # dead value op
+        blk = p.global_block
+        sink = blk.create_var(name="pm_sink", shape=[4], dtype="float32")
+        blk.append_op("py_func", inputs={"X": [h.name]},
+                      outputs={"Out": [sink.name]})  # side effect: survives
+    dec = dce_program(p, fetch_names=[h.name])
+    assert dec.applied
+    kept = [op.type for op in dec.program.global_block.ops]
+    assert "py_func" in kept and "relu" in kept
+    assert "scale" not in kept, kept
+
+
+# ---------------------------------------------------------------------------
+# context plumbing
+# ---------------------------------------------------------------------------
+
+def test_pass_context_options_and_batch():
+    seen = {}
+
+    @register_pass("pm_test_ctx")
+    def probe(program, ctx):
+        seen.update(batch=ctx.batch_size, opt=ctx.options.get("knob"),
+                    feeds=ctx.feed_names, fetches=ctx.fetch_names)
+
+    main, _, loss = _mlp_program()
+    default_pass_manager().run_pipeline(
+        main, ("pm_test_ctx",), feed_names=["x", "y"],
+        fetch_names=[loss.name], batch_size=32, options={"knob": 7},
+        verify="none")
+    assert seen == {"batch": 32, "opt": 7, "feeds": ("x", "y"),
+                    "fetches": (loss.name,)}
+
+
+def test_pass_context_rejects_caching_transforms():
+    main, _, _ = _mlp_program()
+    ctx = PassContext(main)
+    with pytest.raises(ValueError, match="transform"):
+        ctx.analysis("dce")
