@@ -75,6 +75,26 @@ if ! grep -q -- "-> FAIL" "$CHAOS_NEG_LOG"; then
   exit 1
 fi
 
+echo "== serving load gate (paddle_tpu.serving: under injected overload,"
+echo "   compile faults and one watchdog-diagnosed hang, every submitted"
+echo "   request reaches exactly one terminal outcome; p50/p99 latency"
+echo "   histogram is the artifact)"
+JAX_PLATFORMS=cpu python tools/load_check.py --ci \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_serving_report.json" | tail -8
+echo "== serving negative control (shedding disabled: the gate must FAIL)"
+SERVING_NEG_LOG="${CI_ARTIFACT_DIR:-.}/ci_serving_negative.log"
+if JAX_PLATFORMS=cpu python tools/load_check.py --ci \
+     --negative-control > "$SERVING_NEG_LOG" 2>&1; then
+  echo "load_check --ci did NOT fail with shedding disabled" >&2
+  exit 1
+fi
+# non-zero exit must be the gate tripping, not the harness crashing
+if ! grep -q -- "-> FAIL" "$SERVING_NEG_LOG"; then
+  echo "serving negative control exited non-zero WITHOUT tripping the gate:" >&2
+  tail -20 "$SERVING_NEG_LOG" >&2
+  exit 1
+fi
+
 echo "== chaos multichip gate (resilience.distributed: kill inside one shard"
 echo "   write -> serial unpublished + bit-identical resume; elastic 8->4->1"
 echo "   restore; watchdog converts an injected hang, and without it the"

@@ -38,6 +38,7 @@ from . import monitor
 from . import profiler
 from . import regularizer
 from . import resilience
+from . import serving
 from . import analysis
 from .core import registry as op_registry
 from .flags import get_flags, set_flags

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import contextlib
 import itertools
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -96,23 +97,32 @@ class Scope:
         self.vars: Dict[str, Any] = {}
         self.parent = parent
         self._serial = next(Scope._serial_counter)
+        # serving dispatches from its own thread while user code may keep
+        # running the same executor: the var map is lock-guarded so a
+        # concurrent set_var can never tear a read (CPython dicts are
+        # GIL-atomic per op, but read-modify-write sequences are not)
+        self._lock = threading.RLock()
 
     def var(self, name: str):
-        return self.vars.get(name)
+        with self._lock:
+            return self.vars.get(name)
 
     def find_var(self, name: str):
         s = self
         while s is not None:
-            if name in s.vars:
-                return s.vars[name]
+            with s._lock:
+                if name in s.vars:
+                    return s.vars[name]
             s = s.parent
         return None
 
     def set_var(self, name: str, value) -> None:
-        self.vars[name] = value
+        with self._lock:
+            self.vars[name] = value
 
     def drop_var(self, name: str) -> None:
-        self.vars.pop(name, None)
+        with self._lock:
+            self.vars.pop(name, None)
 
     def new_scope(self) -> "Scope":
         return Scope(parent=self)
@@ -219,6 +229,9 @@ class _CompiledStep:
         self._aot = None
         # pending monitor CompileRecord awaiting stage timings
         self._compile_event = None
+        # serializes the one-time AOT build when two threads race the same
+        # step (serving dispatcher vs a user thread)
+        self._aot_lock = threading.Lock()
 
 
 def analyze_block_io(block, feed_names: set, fetch_names) -> dict:
@@ -465,6 +478,11 @@ class Executor:
         # The transformed program is a fresh Program with its own _serial,
         # so step-cache keys can never alias remat and plain variants.
         self._remat_cache: Dict[tuple, Program] = {}
+        # guards the three caches + the seed counter: the serving engine
+        # runs this executor from its dispatch thread while the owning
+        # thread may still call run() — an unguarded dict resize mid-probe
+        # or a torn counter would corrupt the compile cache
+        self._lock = threading.RLock()
 
     def _maybe_auto_remat(self, program: Program, feed, fetch_names):
         """FLAGS_auto_recompute entry shared by run / run_chained /
@@ -489,31 +507,36 @@ class Executor:
         # pay one dict probe, nothing op-count-shaped.
         key = (self._program_fingerprint(program), batch, budget,
                tuple(fetch_names or ()))
-        cached = self._remat_cache.get(key)
-        if cached is not None:
-            return cached
-        from .analysis.remat import is_trainable_program
+        # whole decision under the executor lock: a racing second thread
+        # must reuse the SAME transformed program (a duplicate rebuild
+        # would fork two serials and recompile everything downstream)
+        with self._lock:
+            cached = self._remat_cache.get(key)
+            if cached is not None:
+                return cached
+            from .analysis.remat import is_trainable_program
 
-        # startup/inference programs cannot remat by construction; pass
-        # through (cached) with no monitor record — a 'refused' count here
-        # would read as a training program the pass could not handle
-        if not is_trainable_program(program):
-            self._remat_cache[key] = program
-            return program
-        # the transform runs as a registered pass through the manager
-        # (ROADMAP item 5): at FLAGS_check_program>=2 the pipeline
-        # re-verifies the rebuilt program and refuses a corrupting
-        # transform with PassVerificationError
-        from .analysis.pass_manager import run_transform_pipeline
+            # startup/inference programs cannot remat by construction; pass
+            # through (cached) with no monitor record — a 'refused' count
+            # here would read as a training program the pass could not
+            # handle
+            if not is_trainable_program(program):
+                self._remat_cache[key] = program
+                return program
+            # the transform runs as a registered pass through the manager
+            # (ROADMAP item 5): at FLAGS_check_program>=2 the pipeline
+            # re-verifies the rebuilt program and refuses a corrupting
+            # transform with PassVerificationError
+            from .analysis.pass_manager import run_transform_pipeline
 
-        result = run_transform_pipeline(
-            program, ("auto_remat",), feed_names=sorted(feed or {}),
-            fetch_names=list(fetch_names or ()), batch_size=batch,
-            options={"budget_mb": budget})
-        decision = result.values["auto_remat"]
-        _monitor.record_remat(decision)
-        self._remat_cache[key] = decision.program
-        return decision.program
+            result = run_transform_pipeline(
+                program, ("auto_remat",), feed_names=sorted(feed or {}),
+                fetch_names=list(fetch_names or ()), batch_size=batch,
+                options={"budget_mb": budget})
+            decision = result.values["auto_remat"]
+            _monitor.record_remat(decision)
+            self._remat_cache[key] = decision.program
+            return decision.program
 
     def _verify_once(self, program: Program, fetch_names) -> None:
         """FLAGS_check_program pre-run hook: static-verify each program
@@ -527,12 +550,14 @@ class Executor:
         if not int(flag("check_program")):
             return
         fp = self._program_fingerprint(program)
-        if fp in self._verified:
-            return
+        with self._lock:
+            if fp in self._verified:
+                return
         from .analysis.pass_manager import run_verify_pipeline
 
         run_verify_pipeline(program, fetch_names=fetch_names)
-        self._verified.add(fp)
+        with self._lock:
+            self._verified.add(fp)
 
     # -- public API ------------------------------------------------------
     def run(
@@ -715,7 +740,8 @@ class Executor:
             (n,) + _shape_dtype_sig(v) for n, v in feed.items()))
         key = ("chained", self._program_fingerprint(program), feed_sig,
                tuple(fetch_names), int(steps), scope._serial, xla_opts)
-        step = self._cache.get(key)
+        with self._lock:
+            step = self._cache.get(key)
         mrec = _monitor.step_begin("chained", program)
         if mrec is not None:
             mrec.cache_hit = step is not None
@@ -733,6 +759,19 @@ class Executor:
     def _run_chained_body(self, program, feed, fetch_names, steps, scope,
                           return_numpy, key, step, feed_sig, mrec):
         if step is None:
+            step = self._build_chained_step(program, feed, fetch_names,
+                                            steps, scope, key, feed_sig)
+        return self._dispatch_chained(program, feed, steps, scope,
+                                      return_numpy, step, mrec)
+
+    def _build_chained_step(self, program, feed, fetch_names, steps, scope,
+                            key, feed_sig):
+        # under the executor lock with a double-check: a racing thread
+        # must reuse the same scan wrapper, not fork a second compile
+        with self._lock:
+            step = self._cache.get(key)
+            if step is not None:
+                return step
             block = program.global_block
             io = analyze_block_io(block, set(feed.keys()), fetch_names)
             # carried: ALL read+written state threads through the scan carry
@@ -817,7 +856,10 @@ class Executor:
             step.base_step = base_step
             step.wo_shapes = None
             self._cache[key] = step
+            return step
 
+    def _dispatch_chained(self, program, feed, steps, scope,
+                          return_numpy, step, mrec):
         feed_vals = [self._to_device_array(feed[n], program, n)
                      for n in step.feed_names]
         donated_vals = [scope.find_var(n) for n in step.donated_names]
@@ -937,15 +979,18 @@ class Executor:
         return list(stacked)
 
     def close(self):
-        self._cache.clear()
-        self._verified.clear()
-        self._remat_cache.clear()
+        with self._lock:
+            self._cache.clear()
+            self._verified.clear()
+            self._remat_cache.clear()
 
     # -- internals -------------------------------------------------------
     def _next_seed(self, program: Program) -> int:
-        self._step_counter += 1
+        with self._lock:
+            self._step_counter += 1
+            counter = self._step_counter
         base = program.random_seed or 0
-        return (base * 1_000_003 + self._step_counter) & 0x7FFFFFFF
+        return (base * 1_000_003 + counter) & 0x7FFFFFFF
 
     def _to_device_array(self, value, program, name):
         if isinstance(value, (np.ndarray, list, tuple, int, float)):
@@ -985,29 +1030,35 @@ class Executor:
         key = (self._program_fingerprint(program), feed_sig,
                tuple(fetch_names), scope._serial, flag("check_nan_inf"),
                xla_opts)
-        hit = use_cache and key in self._cache
-        _monitor.record_cache_lookup("run", hit)
-        if mrec is not None:
-            mrec.cache_hit = hit
-        if hit:
-            return self._cache[key]
-        with RecordEvent("executor::build_step"):
-            step = self._compile(program, set(feed.keys()), fetch_names,
-                                 scope)
-        step.program = program
-        step._compile_event = _monitor.observe_compile(
-            "run", program,
-            components={
-                "program": self._program_fingerprint(program)[1:],
-                "feed_signature": feed_sig,
-                "fetch_list": tuple(fetch_names),
-                "scope": scope._serial,
-                "flags": (("check_nan_inf", flag("check_nan_inf")),),
-                "xla_options": xla_opts,
-            },
-            donated_names=step.donated_names)
-        self._cache[key] = step
-        return step
+        # the whole lookup-or-build runs under the executor lock: two
+        # threads racing the same key must share ONE step (and one monitor
+        # compile record); _compile only builds the jit wrapper — the
+        # expensive XLA build happens later under the step's own _aot_lock,
+        # so unrelated steps still compile in parallel
+        with self._lock:
+            hit = use_cache and key in self._cache
+            _monitor.record_cache_lookup("run", hit)
+            if mrec is not None:
+                mrec.cache_hit = hit
+            if hit:
+                return self._cache[key]
+            with RecordEvent("executor::build_step"):
+                step = self._compile(program, set(feed.keys()), fetch_names,
+                                     scope)
+            step.program = program
+            step._compile_event = _monitor.observe_compile(
+                "run", program,
+                components={
+                    "program": self._program_fingerprint(program)[1:],
+                    "feed_signature": feed_sig,
+                    "fetch_list": tuple(fetch_names),
+                    "scope": scope._serial,
+                    "flags": (("check_nan_inf", flag("check_nan_inf")),),
+                    "xla_options": xla_opts,
+                },
+                donated_names=step.donated_names)
+            self._cache[key] = step
+            return step
 
     def _compile(self, program: Program, feed_names: set, fetch_names, scope):
         from .flags import flag, xla_options
@@ -1033,7 +1084,18 @@ class Executor:
         quantities). The compiled executable is kept on the step — later
         calls through it also skip jit dispatch overhead. If lowering
         raises (user shape errors surface at trace time) the jit path is
-        used instead so the original diagnostic is what the user sees."""
+        used instead so the original diagnostic is what the user sees.
+
+        Serialized per step under ``_aot_lock`` (double-checked): when the
+        serving dispatcher and a user thread race the first call of one
+        step, exactly one of them builds and the other waits for the
+        finished executable instead of burning a duplicate XLA compile."""
+        if step._aot is None:
+            with step._aot_lock:
+                return self._ensure_executable_locked(step, args)
+        return step._aot or step.fn
+
+    def _ensure_executable_locked(self, step: _CompiledStep, args):
         if step._aot is None:
             ev, step._compile_event = step._compile_event, None
             t_trace = t_compile = None

@@ -107,6 +107,58 @@ _DEFS: Dict[str, tuple] = {
     "retry_timeout": (float, 30.0,
                       "per-site wall-clock retry budget in seconds across "
                       "all attempts (0 = unlimited)"),
+    # serving (paddle_tpu.serving — docs/SERVING.md). ServingConfig reads
+    # these as its defaults; explicit config fields win.
+    "serving_max_batch": (int, 8,
+                          "serving: largest padded batch per dispatch; "
+                          "shape buckets are powers of two up to this, so "
+                          "one compiled executable per bucket absorbs "
+                          "arbitrary traffic"),
+    "serving_queue_depth": (int, 256,
+                            "serving admission control: queued requests "
+                            "above this are rejected with typed Overloaded "
+                            "(load shedding, never a silent drop)"),
+    "serving_queue_age_s": (float, 5.0,
+                            "serving admission control: when the OLDEST "
+                            "queued request is older than this, new "
+                            "arrivals are shed as Overloaded — queue-age "
+                            "pressure catches a stuck device before the "
+                            "depth bound does (0 disables)"),
+    "serving_deadline_s": (float, 0.0,
+                           "default per-request deadline in seconds "
+                           "(resilience.deadline); an expired request gets "
+                           "typed DeadlineExceeded instead of a stale "
+                           "response. 0 = no default; submit(deadline_s=) "
+                           "overrides per request"),
+    "serving_batch_window_s": (float, 0.0,
+                               "how long the dispatcher waits for a "
+                               "partially-filled batch to fill before "
+                               "dispatching it anyway (0 = dispatch "
+                               "whatever is queued — lowest latency)"),
+    "serving_breaker_threshold": (int, 3,
+                                  "consecutive batch failures that OPEN a "
+                                  "shape bucket's circuit breaker (requests "
+                                  "for that bucket are then rejected "
+                                  "CircuitOpen until a half-open probe "
+                                  "succeeds)"),
+    "serving_breaker_cooldown_s": (float, 0.5,
+                                   "base open->half-open cooldown; each "
+                                   "re-open backs off through the "
+                                   "resilience.retry schedule (doubling, "
+                                   "capped) instead of hammering a broken "
+                                   "bucket"),
+    "serving_degrade_after_s": (float, 1.0,
+                                "sustained overload pressure for this long "
+                                "enters degraded mode: max batch halves "
+                                "and sub-priority requests are shed "
+                                "(docs/SERVING.md)"),
+    "serving_recover_after_s": (float, 1.0,
+                                "pressure-free time before degraded mode "
+                                "restores the full batch ceiling"),
+    "serving_degraded_min_priority": (int, 1,
+                                      "in degraded mode, requests with "
+                                      "priority below this are shed at "
+                                      "admission with typed Overloaded"),
     "auto_recompute": (bool, False,
                        "automatic rematerialisation: on Executor.run / "
                        "run_chained / CompiledProgram, training programs "

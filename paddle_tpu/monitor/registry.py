@@ -120,6 +120,29 @@ class Histogram:
         with self._lock:
             return self._sum
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimate the ``q``-quantile (0 < q <= 1) from the cumulative
+        buckets — Prometheus ``histogram_quantile`` semantics: linear
+        interpolation inside the bucket the target rank lands in, with
+        two honesty clamps the observed ``min``/``max`` make possible:
+        the result never leaves ``[min, max]``, and ranks landing in the
+        +Inf bucket report ``max`` instead of inventing an upper bound.
+        None until something was observed."""
+        if not 0.0 < q <= 1.0:
+            raise ValueError(f"quantile must be in (0, 1], got {q}")
+        with self._lock:
+            if not self._count:
+                return None
+            target = q * self._count
+            cum, lo = 0, 0.0
+            for bound, c in zip(self._bounds, self._bucket_counts):
+                if cum + c >= target:
+                    est = lo + (bound - lo) * (target - cum) / c
+                    return min(max(est, self._min), self._max)
+                cum += c
+                lo = bound
+            return self._max
+
     def snapshot(self) -> dict:
         with self._lock:
             cum, cum_counts = 0, []
@@ -132,6 +155,12 @@ class Histogram:
                 "min": self._min,
                 "max": self._max,
                 "avg": (self._sum / self._count) if self._count else None,
+                # estimated quantiles ride along so every JSON artifact
+                # (metrics_report, load_check) gets SLO percentiles for
+                # free; the registry lock is an RLock, so the nested
+                # quantile() calls see the same consistent state
+                "p50": self.quantile(0.5),
+                "p99": self.quantile(0.99),
                 "buckets": {**{repr(b): c for b, c in
                                zip(self._bounds, cum_counts)},
                             "+Inf": self._count},

@@ -16,6 +16,7 @@ the compiler owns collective placement.
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -105,6 +106,10 @@ class CompiledProgram:
         self._mesh: Optional[Mesh] = None
         self._is_data_parallel = False
         self._cache: Dict[tuple, Any] = {}
+        # same contract as Executor._lock: the step cache must survive
+        # concurrent dispatch threads (serving) without forking duplicate
+        # compiles for one key
+        self._cache_lock = threading.RLock()
 
     @property
     def program(self) -> Program:
@@ -194,9 +199,13 @@ class CompiledProgram:
             if all(getattr(v, "is_fully_addressable", True)
                    for v in donated_vals):
                 # host-side pre-step image: a device-side copy would lose
-                # the mesh sharding; np.asarray gathers the exact bits and
-                # the restore re-shards on the next step's read()
-                rollback = [(n, np.asarray(v))
+                # the mesh sharding; the restore re-shards on the next
+                # step's read(). MUST be an owned copy — np.asarray of a
+                # CPU-backend jax array can be a zero-copy VIEW of the
+                # device buffer, and that buffer is donated below: XLA
+                # would write the post-step (possibly non-finite) values
+                # straight through the "pre-step" image
+                rollback = [(n, np.array(v, copy=True))
                             for n, v in zip(step.donated_names,
                                             donated_vals)]
             # multi-process global arrays cannot be host-imaged here; the
@@ -281,39 +290,48 @@ class CompiledProgram:
         xla_opts = tuple(sorted(xla_options().items()))
         key = (exe._program_fingerprint(program), feed_sig,
                tuple(fetch_names), flag("check_nan_inf"), xla_opts)
-        hit = key in self._cache
-        _monitor.record_cache_lookup("parallel", hit)
-        if mrec is not None:
-            mrec.cache_hit = hit
-        if hit:
-            return self._cache[key]
+        with self._cache_lock:
+            hit = key in self._cache
+            _monitor.record_cache_lookup("parallel", hit)
+            if mrec is not None:
+                mrec.cache_hit = hit
+            if hit:
+                return self._cache[key]
 
         # compile-site fault probe + transient retry (the actual XLA
-        # compile happens lazily at first dispatch on this path; the probe
-        # models the build pipeline's transient failures). Only the probe
-        # is retried: a real build failure must surface its ORIGINAL
-        # diagnostic immediately, exactly like the single-device path
+        # compile happens lazily at first dispatch on this path; the
+        # probe models the build pipeline's transient failures). Only
+        # the probe is retried: a real build failure must surface its
+        # ORIGINAL diagnostic immediately, exactly like the
+        # single-device path. OUTSIDE the cache lock: retry backoff can
+        # sleep for seconds, and concurrent cache HITS must not queue
+        # behind it
         call_with_retry("compile", _faults.fault_point, "compile")
-        with RecordEvent("executor::build_step"), \
-                _dist.watchdog_section("compile", program=program):
-            step = self._compile(program, set(feed.keys()), fetch_names,
-                                 scope)
-        step.program = program
-        # the data-parallel path keeps jit dispatch (shardings make the
-        # AOT fast path fiddly across process topologies), so the compile
-        # event completes here without stage timings
-        _monitor.complete_compile(_monitor.observe_compile(
-            "parallel", program,
-            components={
-                "program": exe._program_fingerprint(program)[1:],
-                "feed_signature": feed_sig,
-                "fetch_list": tuple(fetch_names),
-                "flags": (("check_nan_inf", flag("check_nan_inf")),),
-                "xla_options": xla_opts,
-            },
-            donated_names=step.donated_names), None, None)
-        self._cache[key] = step
-        return step
+        with self._cache_lock:
+            step = self._cache.get(key)
+            if step is not None:
+                # a racing thread built it while we were probing
+                return step
+            with RecordEvent("executor::build_step"), \
+                    _dist.watchdog_section("compile", program=program):
+                step = self._compile(program, set(feed.keys()), fetch_names,
+                                     scope)
+            step.program = program
+            # the data-parallel path keeps jit dispatch (shardings make the
+            # AOT fast path fiddly across process topologies), so the
+            # compile event completes here without stage timings
+            _monitor.complete_compile(_monitor.observe_compile(
+                "parallel", program,
+                components={
+                    "program": exe._program_fingerprint(program)[1:],
+                    "feed_signature": feed_sig,
+                    "fetch_list": tuple(fetch_names),
+                    "flags": (("check_nan_inf", flag("check_nan_inf")),),
+                    "xla_options": xla_opts,
+                },
+                donated_names=step.donated_names), None, None)
+            self._cache[key] = step
+            return step
 
     def _compile(self, program: Program, feed_names: set, fetch_names, scope):
         """Same env-threading as Executor._compile, but jitted with shardings

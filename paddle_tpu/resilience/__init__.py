@@ -41,6 +41,7 @@ from .checkpoint import (CKPT_CODES, FORMAT_VERSION, CheckpointCorruptError,
                          atomic_replace_dir, finalize_manifest, iter_serials,
                          load_latest_checkpoint, verify_checkpoint,
                          verify_sharding_section)
+from .deadline import Deadline, DeadlineExceeded
 from .distributed import (ReplicaDivergenceError, WatchdogTimeout,
                           handle_divergence, replica_divergence_check,
                           set_divergence_recovery, watchdog_section)
@@ -62,9 +63,10 @@ __all__ = [
     # fault injection
     "FaultPlan", "InjectedFault", "fault_point", "fault_plan_guard",
     "install_plan", "clear_plan", "active_plan", "SITES",
-    # retry
+    # retry + deadlines (one implementation for retry budgets AND serving
+    # request deadlines)
     "RetryPolicy", "RetryExhaustedError", "retrying", "call_with_retry",
-    "is_transient", "policy_for",
+    "is_transient", "policy_for", "Deadline", "DeadlineExceeded",
     # non-finite degradation
     "POLICIES",
 ]

@@ -516,6 +516,34 @@ def handle_divergence(diverged: Sequence[str], path: str = "parallel",
 # pillar 3: step watchdog
 # ---------------------------------------------------------------------------
 
+class _WatchdogInterrupt(BaseException):
+    """Async exception the watchdog raises INSIDE a hung non-main thread
+    (``PyThreadState_SetAsyncExc``) — the cross-thread analogue of the
+    ``interrupt_main``/KeyboardInterrupt path the main thread gets. A
+    ``BaseException`` so broad ``except Exception`` handlers inside the
+    hung section cannot swallow it; ``watchdog_section`` converts it to
+    :class:`WatchdogTimeout` before callers see it. Serving's dispatch
+    thread is the reason this exists: a slow-batch hang there must die
+    diagnosed and typed, not ride straight to the hard-exit escalation."""
+
+
+def _interrupt_thread(thread_id: int) -> bool:
+    """Raise :class:`_WatchdogInterrupt` asynchronously in ``thread_id``.
+    Delivery happens at the thread's next bytecode boundary — enough for
+    Python-level stalls (the ``hang`` fault action sleeps in 20 ms slices);
+    a hang inside native code stays for the hard-exit escalation."""
+    import ctypes
+
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(thread_id), ctypes.py_object(_WatchdogInterrupt))
+    if res > 1:
+        # "affected more than one thread" — undo per CPython docs
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(thread_id), None)
+        return False
+    return res == 1
+
+
 class WatchdogTimeout(RuntimeError):
     """An armed compile/step/collective section exceeded
     ``FLAGS_step_timeout_s``. The full diagnosis (all thread stacks, the
@@ -608,11 +636,15 @@ def _wd_loop() -> None:
                     _monitor.record_watchdog_timeout(s.section)
                 except Exception:
                     pass
-                if s.thread_id == threading.main_thread().ident:
-                    with _wd_lock:
-                        still = s.token in _wd_armed
-                    if still:
+                with _wd_lock:
+                    still = s.token in _wd_armed
+                if still:
+                    if s.thread_id == threading.main_thread().ident:
                         _thread.interrupt_main()
+                    else:
+                        # non-main thread (e.g. the serving dispatcher):
+                        # deliver the typed interrupt directly into it
+                        _interrupt_thread(s.thread_id)
             elif s.expired and s.hard_deadline is not None \
                     and now >= s.hard_deadline:
                 with _wd_lock:
@@ -647,11 +679,13 @@ def watchdog_section(section: str, detail: str = "", timeout=None,
 
     ``timeout`` defaults to ``FLAGS_step_timeout_s``; 0/None disarms (the
     default — the context manager is then a no-op). When the deadline
-    fires the watchdog dumps the diagnosis and interrupts the main
-    thread; the pending ``KeyboardInterrupt`` is converted to
-    :class:`WatchdogTimeout` here, so callers see one typed, documented
-    failure instead of a hang. Sections armed from non-main threads get
-    the dump + hard-exit escalation but cannot be interrupted."""
+    fires the watchdog dumps the diagnosis and interrupts the hung
+    thread — ``interrupt_main`` for the main thread, an async
+    :class:`_WatchdogInterrupt` (``PyThreadState_SetAsyncExc``) for any
+    other thread, e.g. the serving dispatcher. Either pending interrupt
+    is converted to :class:`WatchdogTimeout` here, so callers see one
+    typed, documented failure instead of a hang; a section stuck in
+    uninterruptible native code still escalates to the hard exit."""
     if timeout is None:
         from ..flags import flag
 
@@ -683,19 +717,24 @@ def watchdog_section(section: str, detail: str = "", timeout=None,
             converted = True
             raise WatchdogTimeout(section, s.timeout, s.detail) from None
         raise
+    except _WatchdogInterrupt:
+        # the cross-thread delivery path (non-main sections): always ours
+        # — nothing else raises this type
+        converted = True
+        raise WatchdogTimeout(section, s.timeout, s.detail) from None
     finally:
         with _wd_lock:
             _wd_armed.pop(s.token, None)
         if s.expired and not converted:
             # the section finished in the race window between expiry and
-            # interrupt delivery: absorb the in-flight KeyboardInterrupt
-            # here (it was aimed at this section) instead of letting it
-            # detonate in whatever innocent code runs next. The watchdog
-            # polls every 0.05s, so a few short sleeps cover the window.
+            # interrupt delivery: absorb the in-flight interrupt here (it
+            # was aimed at this section) instead of letting it detonate in
+            # whatever innocent code runs next. The watchdog polls every
+            # 0.05s, so a few short sleeps cover the window.
             try:
                 for _ in range(4):
                     time.sleep(0.02)
-            except KeyboardInterrupt:
+            except (KeyboardInterrupt, _WatchdogInterrupt):
                 logger.warning(
                     "watchdog: absorbed a late interrupt for section "
                     "'%s' that completed at its deadline", section)

@@ -28,6 +28,15 @@ site           probed where
 ``hang``       inside the watchdog-armed dispatch section of every executor
                path (run / run_chained / CompiledProgram) — pair with the
                ``hang`` action to stall a step the watchdog must break
+``enqueue``    ``serving.ServingEngine.submit`` before admission control —
+               an injected fault here is a typed submission failure the
+               caller sees (never a silent drop)
+``batch_dispatch`` in the serving dispatch thread immediately before a
+               batch executes — an injected fault fails that batch's
+               requests with typed errors and feeds the circuit breaker
+``overload``   inside serving admission control — a fired rule forces the
+               request to be rejected ``Overloaded`` exactly as if the
+               queue were full (synthetic pressure for the load gate)
 =============  ==============================================================
 
 Plan grammar (``FLAGS_fault_plan``, comma-separated rules)::
@@ -66,7 +75,7 @@ __all__ = ["FaultPlan", "InjectedFault", "fault_point", "install_plan",
 logger = logging.getLogger("paddle_tpu.resilience")
 
 SITES = ("compile", "device_put", "step", "ckpt_write", "shard_write",
-         "hang")
+         "hang", "enqueue", "batch_dispatch", "overload")
 
 # injected exceptions carry this mixin so retry/give-up handlers can tell a
 # scripted fault from a real infrastructure error (real errors keep their
@@ -111,15 +120,21 @@ class _Rule:
 
 class FaultPlan:
     """A parsed, seeded fault schedule. Hit counters are per-plan (and the
-    plan is per-process), so the same spec replays the same faults."""
+    plan is per-process), so the same spec replays the same faults. Hit
+    accounting is lock-guarded: serving probes ``enqueue`` from concurrent
+    submitter threads, and a torn counter would make an ``@K`` rule fire
+    twice or never."""
 
     def __init__(self, spec: str = "", seed: int = 0):
+        import threading
+
         self.spec = spec or ""
         self.seed = int(seed)
         self.rules: Dict[str, List[_Rule]] = {}
         self.hits: Dict[str, int] = {}
         self.fired: List[tuple] = []   # (site, hit, action) audit trail
         self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
         for part in filter(None, (p.strip() for p in self.spec.split(","))):
             m = _RULE_RE.match(part)
             if not m:
@@ -149,41 +164,49 @@ class FaultPlan:
 
     def hit(self, site: str) -> None:
         """Record one pass through ``site``; perform the scheduled action if
-        a rule fires (raise an injected exception or kill the process)."""
+        a rule fires (raise an injected exception or kill the process).
+        Counting and rule evaluation run under the plan lock; the action
+        itself runs outside it (a ``hang`` must never stall other threads'
+        probes)."""
         rules = self.rules.get(site)
         if not rules:
             return
-        self.hits[site] = k = self.hits.get(site, 0) + 1
-        for rule in rules:
-            if not rule.fires(k, self._rng):
-                continue
-            self.fired.append((site, k, rule.action))
-            from .. import monitor as _monitor
+        with self._lock:
+            self.hits[site] = k = self.hits.get(site, 0) + 1
+            fired_rule = next(
+                (r for r in rules if r.fires(k, self._rng)), None)
+            if fired_rule is not None:
+                self.fired.append((site, k, fired_rule.action))
+        if fired_rule is None:
+            return
+        rule = fired_rule
+        from .. import monitor as _monitor
 
-            if _monitor.enabled():
-                _monitor.counter(
-                    "resilience_faults_injected_total",
-                    "faults fired by the FLAGS_fault_plan schedule").labels(
-                    site=site, action=rule.action).inc()
-            if rule.action == "kill":
-                logger.warning("fault_plan: KILL at site '%s' (hit #%d)",
-                               site, k)
-                os._exit(137)
-            if rule.action == "hang":
-                import time
+        if _monitor.enabled():
+            _monitor.counter(
+                "resilience_faults_injected_total",
+                "faults fired by the FLAGS_fault_plan schedule").labels(
+                site=site, action=rule.action).inc()
+        if rule.action == "kill":
+            logger.warning("fault_plan: KILL at site '%s' (hit #%d)",
+                           site, k)
+            os._exit(137)
+        if rule.action == "hang":
+            import time
 
-                logger.warning("fault_plan: HANG at site '%s' (hit #%d) — "
-                               "stalling until interrupted", site, k)
-                # short sleeps so a pending interrupt (the watchdog's
-                # interrupt_main) is delivered between iterations; a single
-                # long sleep would ride out the interrupt flag in C
-                while True:
-                    time.sleep(0.02)
-            logger.warning("fault_plan: injecting %s at site '%s' (hit #%d)",
-                           rule.action, site, k)
-            raise _injected_class(rule.action)(
-                f"[resilience] injected {rule.action} at site '{site}' "
-                f"(hit #{k} of plan '{self.spec}')")
+            logger.warning("fault_plan: HANG at site '%s' (hit #%d) — "
+                           "stalling until interrupted", site, k)
+            # short sleeps so a pending interrupt (the watchdog's
+            # interrupt_main, or its cross-thread async raise) is
+            # delivered between iterations; a single long sleep would
+            # ride out the interrupt flag in C
+            while True:
+                time.sleep(0.02)
+        logger.warning("fault_plan: injecting %s at site '%s' (hit #%d)",
+                       rule.action, site, k)
+        raise _injected_class(rule.action)(
+            f"[resilience] injected {rule.action} at site '{site}' "
+            f"(hit #{k} of plan '{self.spec}')")
 
 
 # -- active-plan resolution -------------------------------------------------

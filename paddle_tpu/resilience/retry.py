@@ -26,6 +26,8 @@ import random
 import time
 from typing import Callable, Optional
 
+from .deadline import Deadline
+
 __all__ = ["RetryPolicy", "RetryExhaustedError", "call_with_retry",
            "retrying", "is_transient", "policy_for"]
 
@@ -134,9 +136,11 @@ def call_with_retry(site: str, fn: Callable, *args,
                 # replays identically across runs
                 rng = random.Random((int(flag("fault_seed")) << 16)
                                     ^ zlib.crc32(site.encode()))
-                if pol.timeout:
-                    deadline = time.monotonic() + pol.timeout
-            out_of_time = deadline is not None and time.monotonic() >= deadline
+                # the per-site budget is one Deadline (shared with the
+                # serving request deadlines — resilience.deadline), started
+                # at the first failure so the happy path stays free
+                deadline = Deadline(pol.timeout, what=f"retry site '{site}'")
+            out_of_time = deadline.expired
             if attempt >= pol.max_attempts or out_of_time:
                 if _monitor.enabled():
                     _monitor.counter(

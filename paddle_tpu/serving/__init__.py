@@ -1,0 +1,42 @@
+"""paddle_tpu.serving — production inference serving with continuous
+batching, admission control, deadlines and graceful degradation.
+
+The online path the ROADMAP's "millions of users" north star needs
+(item 1): the training stack already survives crashes, hangs and bad
+batches (``paddle_tpu.resilience``, PRs 4/6); this package gives the
+SAME guarantees to request traffic. The contract is one sentence: *every
+submitted request reaches exactly one terminal outcome — a response or a
+typed rejection — even under overload, compile failures and injected
+faults.* ``tools/load_check.py`` proves it in CI.
+
+Quick start::
+
+    from paddle_tpu import serving
+
+    infer = main.clone(for_test=True)          # params already in `scope`
+    engine = serving.ServingEngine(infer, feed_names=["img", "label"],
+                                   fetch_list=[logits], scope=scope)
+    engine.warm_up()                           # pre-compile the buckets
+    with engine:                               # start()/stop(drain=True)
+        fut = engine.submit({"img": x, "label": y}, deadline_s=0.5)
+        logits_rows = fut.result()             # or typed ServingError
+
+Architecture, flag table and failure modes: docs/SERVING.md. SLO metrics
+(latency p50/p99, queue depth, occupancy, shed/deadline/breaker
+counters): docs/OBSERVABILITY.md.
+"""
+from __future__ import annotations
+
+from ..resilience.deadline import Deadline, DeadlineExceeded
+from .breaker import CircuitBreaker
+from .engine import (BatchFailed, CircuitOpen, EngineStopped, Overloaded,
+                     ServingConfig, ServingEngine, ServingError,
+                     ServingFuture)
+
+__all__ = [
+    "ServingEngine", "ServingConfig", "ServingFuture", "CircuitBreaker",
+    "Deadline",
+    # typed terminal outcomes
+    "ServingError", "Overloaded", "CircuitOpen", "BatchFailed",
+    "EngineStopped", "DeadlineExceeded",
+]

@@ -1,0 +1,861 @@
+"""ServingEngine: continuous batching over compiled executables.
+
+The online-serving counterpart of ``Executor.run`` (ROADMAP item 1; the
+reference tree's ``paddle/fluid/inference`` server role). One engine owns
+one inference program + one scope of loaded parameters + one
+:class:`~paddle_tpu.executor.Executor`, and turns arbitrary concurrent
+traffic into a small set of padded shape buckets so a handful of AOT
+executables absorbs everything:
+
+* callers ``submit()`` single requests from any thread — admission
+  control answers immediately (accept, or a TYPED rejection; never a
+  silent drop);
+* a dedicated dispatch thread drains the queue, groups requests by feed
+  signature, pads the concatenated batch up to the next power-of-two
+  bucket, and runs the executor while callers wait on futures — the
+  device stays busy while the host batches;
+* every admitted request reaches EXACTLY ONE terminal outcome: a
+  response, :class:`DeadlineExceeded`, :class:`Overloaded`,
+  :class:`CircuitOpen`, :class:`BatchFailed` or :class:`EngineStopped`.
+  ``accounting()`` exposes the exact ints; ``tools/load_check.py`` gates
+  on ``submitted == sum(outcomes)`` under injected chaos.
+
+Robustness surface (docs/SERVING.md):
+
+* **deadlines** — each request carries a ``resilience.Deadline`` (the
+  same implementation the retry budgets use); expired requests are swept
+  to ``DeadlineExceeded`` before they waste a batch slot.
+* **admission control / load shedding** — bounded queue depth and
+  oldest-request age; over either bound new arrivals get ``Overloaded``.
+* **circuit breaker** — per shape bucket (``serving.breaker``): repeated
+  batch failures quarantine the bucket, cooling down through the
+  ``resilience.retry`` backoff schedule, half-open probe, close on
+  success.
+* **graceful degradation** — sustained pressure halves the batch ceiling
+  (bounding per-batch latency) and sheds sub-priority requests; both
+  restore when pressure clears.
+* **fault isolation** — a failing batch (injected fault, compile
+  failure past the retry budget, ``FLAGS_check_nan_inf`` trip, watchdog
+  timeout on a hung step) fails only that batch's requests, typed; the
+  engine keeps serving. The ``hang`` fault site fires inside the
+  executor's watchdog-armed section, and the watchdog can now break
+  non-main threads, so a slow batch dies diagnosed.
+
+Fault sites for the chaos gate: ``enqueue`` (submission), ``overload``
+(forced shed), ``batch_dispatch`` (batch failure) + the executor's own
+``compile``/``step``/``hang``. SLO metrics land on ``paddle_tpu.monitor``
+(docs/OBSERVABILITY.md): request latency histogram with p50/p99, queue
+depth, batch occupancy, shed/deadline/breaker counters.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import monitor as _monitor
+from ..executor import Executor, Scope
+from ..framework import Variable
+from ..resilience import faults as _faults
+from ..resilience.deadline import Deadline, DeadlineExceeded
+from .breaker import CircuitBreaker
+
+__all__ = ["ServingConfig", "ServingEngine", "ServingFuture",
+           "ServingError", "Overloaded", "CircuitOpen", "BatchFailed",
+           "EngineStopped", "DeadlineExceeded"]
+
+logger = logging.getLogger("paddle_tpu.serving")
+
+OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# typed terminal outcomes
+# ---------------------------------------------------------------------------
+
+class ServingError(RuntimeError):
+    """Base of every typed serving rejection/failure. ``transient =
+    False``: the retry classifier must never absorb one — each is a
+    deliberate terminal outcome, not an infrastructure hiccup."""
+
+    transient = False
+
+
+class Overloaded(ServingError):
+    """Admission control shed this request (queue depth/age bound,
+    degraded-mode priority shed, or injected overload pressure).
+    ``reason`` names which bound tripped."""
+
+    def __init__(self, msg: str, reason: str = "queue_full"):
+        self.reason = reason
+        super().__init__(msg)
+
+
+class CircuitOpen(ServingError):
+    """The request's shape bucket is quarantined by its circuit breaker
+    (repeated batch failures); retry after the cooldown."""
+
+    def __init__(self, msg: str, bucket: str = ""):
+        self.bucket = bucket
+        super().__init__(msg)
+
+
+class BatchFailed(ServingError):
+    """The batch this request was dispatched in failed; ``__cause__`` is
+    the underlying error (injected fault, compile giveup, nan trip,
+    watchdog timeout). Only this batch failed — the engine keeps
+    serving."""
+
+
+class EngineStopped(ServingError):
+    """The engine is not running (never started, or stopped without
+    drain while this request was queued)."""
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+def _flag_default(value, name):
+    from ..flags import flag
+
+    return flag(name) if value is None else value
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Engine knobs. ``None`` fields resolve from the ``FLAGS_serving_*``
+    family at engine construction (docs/SERVING.md flag table), so a
+    deployment can be tuned entirely through flags while tests pass
+    explicit values."""
+
+    max_batch: Optional[int] = None
+    queue_depth: Optional[int] = None
+    queue_age_s: Optional[float] = None
+    deadline_s: Optional[float] = None          # 0 = no default deadline
+    batch_window_s: Optional[float] = None
+    breaker_threshold: Optional[int] = None
+    breaker_cooldown_s: Optional[float] = None
+    degrade_after_s: Optional[float] = None
+    recover_after_s: Optional[float] = None
+    degraded_min_priority: Optional[int] = None
+
+    def resolve(self) -> "ServingConfig":
+        r = ServingConfig(
+            max_batch=int(_flag_default(self.max_batch,
+                                        "serving_max_batch")),
+            queue_depth=int(_flag_default(self.queue_depth,
+                                          "serving_queue_depth")),
+            queue_age_s=float(_flag_default(self.queue_age_s,
+                                            "serving_queue_age_s")),
+            deadline_s=float(_flag_default(self.deadline_s,
+                                           "serving_deadline_s")),
+            batch_window_s=float(_flag_default(self.batch_window_s,
+                                               "serving_batch_window_s")),
+            breaker_threshold=int(_flag_default(
+                self.breaker_threshold, "serving_breaker_threshold")),
+            breaker_cooldown_s=float(_flag_default(
+                self.breaker_cooldown_s, "serving_breaker_cooldown_s")),
+            degrade_after_s=float(_flag_default(
+                self.degrade_after_s, "serving_degrade_after_s")),
+            recover_after_s=float(_flag_default(
+                self.recover_after_s, "serving_recover_after_s")),
+            degraded_min_priority=int(_flag_default(
+                self.degraded_min_priority, "serving_degraded_min_priority")),
+        )
+        if r.max_batch < 1:
+            raise ValueError(f"serving: max_batch must be >= 1, got "
+                             f"{r.max_batch}")
+        if r.queue_depth < 1:
+            raise ValueError(f"serving: queue_depth must be >= 1, got "
+                             f"{r.queue_depth}")
+        return r
+
+
+# ---------------------------------------------------------------------------
+# request + future
+# ---------------------------------------------------------------------------
+
+class ServingFuture:
+    """One request's pending terminal outcome. Settled exactly once by
+    the engine; a second settle attempt is an engine bug and raises."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._result: Optional[List[np.ndarray]] = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
+        """The fetch arrays (rows of this request only), or raises the
+        typed terminal error. ``timeout`` is a local wait bound — it does
+        NOT cancel the request (the engine still settles it)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving: result() wait timed out; the "
+                               "request is still pending (not cancelled)")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def exception(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving: exception() wait timed out")
+        return self._error
+
+    # -- engine side -----------------------------------------------------
+    def _settle(self, result=None, error=None) -> None:
+        with self._lock:
+            if self._event.is_set():
+                raise RuntimeError(
+                    "serving internal error: second terminal outcome for "
+                    "one request (exactly-once accounting violated)")
+            self._result, self._error = result, error
+            self._event.set()
+
+
+@dataclasses.dataclass
+class _Request:
+    seq: int
+    feed: Dict[str, np.ndarray]
+    nrows: int
+    sig: tuple
+    priority: int
+    deadline: Optional[Deadline]
+    submitted: float
+    future: ServingFuture
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+class ServingEngine:
+    """See module docstring. Construction wires program/scope/executor;
+    ``start()`` spawns the dispatch thread; ``submit()`` is thread-safe.
+
+    The program must be an inference program (e.g. ``clone(for_test=True)``
+    or ``io.load_inference_model``) whose parameters are already in
+    ``scope`` — the engine never mutates the program and shares one
+    compiled executable per (feed signature, bucket) through the
+    executor's (now lock-guarded) step cache."""
+
+    _seq = itertools.count()
+
+    def __init__(self, program, feed_names: Sequence[str], fetch_list,
+                 scope: Optional[Scope] = None, place=None,
+                 executor: Optional[Executor] = None,
+                 config: Optional[ServingConfig] = None):
+        self._program = program
+        self._feed_names = [f.name if isinstance(f, Variable) else f
+                            for f in feed_names]
+        self._fetch_names = [f.name if isinstance(f, Variable) else f
+                             for f in (fetch_list or [])]
+        self._scope = scope if scope is not None else Scope()
+        self._exe = executor or Executor(place)
+        self.config = (config or ServingConfig()).resolve()
+
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queue: List[_Request] = []
+        self._running = False
+        self._stopped = False
+        self._drain = True
+        self._thread: Optional[threading.Thread] = None
+
+        # degradation state (guarded by _lock)
+        self._degraded = False
+        self._cur_max_batch = self.config.max_batch
+        self._pressure_since: Optional[float] = None
+        self._calm_since: Optional[float] = None
+
+        # per-bucket breakers; inserted by the dispatch thread under
+        # _lock so health probes can snapshot the dict from any thread
+        self._breakers: Dict[tuple, CircuitBreaker] = {}
+        # requests taken off the queue but not yet settled (their batch
+        # is executing): part of accounting()'s pending count
+        self._dispatched = 0
+        # the batch currently executing (dispatch thread only; read by
+        # the crash guard to settle in-flight requests typed)
+        self._current_batch: List[_Request] = []
+
+        # exact request accounting (guarded by _lock): the load gate's
+        # ground truth. submitted == sum(all other keys) + pending queue
+        self._acct = {"submitted": 0, "completed": 0, "failed": 0,
+                      "shed": 0, "deadline_exceeded": 0, "circuit_open": 0,
+                      "rejected_fault": 0, "rejected_stopped": 0}
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ServingEngine":
+        with self._lock:
+            if self._stopped:
+                raise EngineStopped("serving: engine was stopped; build a "
+                                    "fresh ServingEngine")
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(target=self._dispatch_loop,
+                                        name="paddle_tpu-serving-dispatch",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop serving. ``drain=True`` lets the dispatcher finish every
+        queued request first; ``drain=False`` fails queued requests with
+        typed :class:`EngineStopped`. Either way each queued request
+        still reaches exactly one terminal outcome."""
+        with self._lock:
+            self._running = False
+            self._stopped = True
+            self._drain = drain
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                logger.error("serving: dispatch thread did not exit within "
+                             "%gs at stop()", timeout)
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop(drain=True)
+        return False
+
+    # -- submission ------------------------------------------------------
+    def submit(self, feed: Dict[str, Any], *, priority: int = 0,
+               deadline_s: Optional[float] = None) -> ServingFuture:
+        """Admit one request (any thread). ``feed`` maps every declared
+        feed name to an array with a leading batch dim (usually 1).
+        Raises a typed :class:`ServingError` subclass when rejected —
+        that raise IS the request's terminal outcome."""
+        # validation first: a malformed feed (ValueError) is a caller bug,
+        # not a submitted request — it never enters the accounting
+        req = self._build_request(feed, priority, deadline_s)
+        with self._lock:
+            self._acct["submitted"] += 1
+        try:
+            # injected submission failure: typed outcome at the caller
+            _faults.fault_point("enqueue")
+        except _faults.InjectedFault:
+            self._account("rejected_fault")
+            raise
+        now = time.monotonic()
+        with self._lock:
+            if not self._running:
+                self._acct["rejected_stopped"] += 1
+                self._record_outcome("rejected_stopped")
+                raise EngineStopped("serving: engine not running")
+            self._admit_locked(req, now)   # raises Overloaded on shed
+            self._queue.append(req)
+            self._gauge_depth_locked()
+            self._work.notify()
+        return req.future
+
+    def _build_request(self, feed, priority, deadline_s) -> _Request:
+        vals = {}
+        nrows = None
+        for n in self._feed_names:
+            if n not in feed:
+                raise ValueError(f"serving: feed missing declared input "
+                                 f"'{n}' (need {self._feed_names})")
+            a = np.asarray(feed[n])
+            if a.ndim == 0:
+                raise ValueError(f"serving: feed '{n}' must have a leading "
+                                 f"batch dim")
+            if nrows is None:
+                nrows = int(a.shape[0])
+            elif int(a.shape[0]) != nrows:
+                raise ValueError(
+                    f"serving: inconsistent batch dims in one request: "
+                    f"'{n}' has {a.shape[0]}, expected {nrows}")
+            vals[n] = a
+        if not vals:
+            raise ValueError("serving: empty feed")
+        if nrows > self.config.max_batch:
+            raise ValueError(
+                f"serving: request rows {nrows} exceed max_batch "
+                f"{self.config.max_batch}; split the request")
+        sig = tuple((n, tuple(vals[n].shape[1:]), str(vals[n].dtype))
+                    for n in self._feed_names)
+        budget = self.config.deadline_s if deadline_s is None else deadline_s
+        seq = next(ServingEngine._seq)
+        dl = Deadline(budget, what=f"serving request #{seq}") \
+            if budget and budget > 0 else None
+        return _Request(seq=seq, feed=vals, nrows=nrows, sig=sig,
+                        priority=int(priority), deadline=dl,
+                        submitted=time.monotonic(), future=ServingFuture())
+
+    def _admit_locked(self, req: _Request, now: float) -> None:
+        """Admission control under ``_lock``: raises typed Overloaded on
+        any shed. Every rejection is accounted before it raises."""
+        try:
+            _faults.fault_point("overload")
+        except _faults.InjectedFault as e:
+            self._shed_locked("injected", now)
+            raise Overloaded("serving: injected overload pressure "
+                             "(FLAGS_fault_plan)", reason="injected") from e
+        if len(self._queue) >= self.config.queue_depth:
+            self._shed_locked("queue_full", now)
+            raise Overloaded(
+                f"serving: queue full ({len(self._queue)} >= "
+                f"{self.config.queue_depth} queued requests)",
+                reason="queue_full")
+        if self.config.queue_age_s > 0 and self._queue:
+            oldest = now - self._queue[0].submitted
+            if oldest > self.config.queue_age_s:
+                self._shed_locked("queue_age", now)
+                raise Overloaded(
+                    f"serving: oldest queued request is {oldest:.2f}s old "
+                    f"(bound {self.config.queue_age_s:g}s) — the device is "
+                    f"not keeping up", reason="queue_age")
+        if self._degraded \
+                and req.priority < self.config.degraded_min_priority:
+            self._shed_locked("priority", now)
+            raise Overloaded(
+                f"serving: degraded mode sheds priority {req.priority} < "
+                f"{self.config.degraded_min_priority}", reason="priority")
+        self._update_pressure_locked(now)
+
+    def _shed_locked(self, reason: str, now: float) -> None:
+        self._acct["shed"] += 1
+        self._record_outcome("shed")
+        if _monitor.enabled():
+            _monitor.counter(
+                "serving_shed_total",
+                "requests shed by admission control, by reason").labels(
+                reason=reason).inc()
+        # a shed IS pressure: it feeds the degradation clock
+        self._pressure_since = self._pressure_since or now
+        self._calm_since = None
+        self._update_pressure_locked(now)
+
+    # -- degradation -----------------------------------------------------
+    def _update_pressure_locked(self, now: float) -> None:
+        depth = len(self._queue)
+        pressured = depth >= max(1, (3 * self.config.queue_depth) // 4)
+        if not pressured and self.config.queue_age_s > 0 and self._queue:
+            pressured = (now - self._queue[0].submitted
+                         > self.config.queue_age_s / 2)
+        if pressured:
+            self._pressure_since = self._pressure_since or now
+            self._calm_since = None
+        elif self._pressure_since is not None or self._degraded:
+            self._calm_since = self._calm_since or now
+            self._pressure_since = None
+        if (not self._degraded and self._pressure_since is not None
+                and now - self._pressure_since
+                >= self.config.degrade_after_s):
+            self._degraded = True
+            self._cur_max_batch = max(1, self.config.max_batch // 2)
+            logger.warning(
+                "serving: sustained overload for %.2fs — DEGRADED mode "
+                "(max batch %d -> %d; shedding priority < %d)",
+                now - self._pressure_since, self.config.max_batch,
+                self._cur_max_batch, self.config.degraded_min_priority)
+            if _monitor.enabled():
+                _monitor.counter("serving_degradations_total",
+                                 "entries into degraded mode").inc()
+                _monitor.gauge("serving_degraded",
+                               "1 while degraded (shrunk batch + priority "
+                               "shedding)").set(1)
+        elif (self._degraded and self._calm_since is not None
+                and now - self._calm_since >= self.config.recover_after_s):
+            self._degraded = False
+            self._cur_max_batch = self.config.max_batch
+            self._calm_since = None
+            logger.warning("serving: pressure cleared — restored full "
+                           "batch ceiling %d", self.config.max_batch)
+            if _monitor.enabled():
+                _monitor.gauge("serving_degraded",
+                               "1 while degraded (shrunk batch + priority "
+                               "shedding)").set(0)
+
+    # -- dispatch thread -------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        """Crash-guarded shell: whatever kills the inner loop (a bug in
+        result slicing, a monitor conflict, the future's double-settle
+        guard) must NOT strand callers blocked on futures — every taken
+        and queued request still gets a typed terminal outcome, and the
+        engine stops admitting instead of queueing into a dead thread."""
+        try:
+            self._dispatch_forever()
+        except BaseException as e:
+            logger.exception(
+                "serving: dispatch thread DIED (%s) — failing queued and "
+                "in-flight requests typed, engine stops admitting",
+                type(e).__name__)
+            with self._lock:
+                self._running = False
+                self._stopped = True
+                leftovers, self._queue = self._queue, []
+                self._gauge_depth_locked()
+            for r in (self._current_batch or []):
+                if not r.future.done():
+                    self._settle_error(
+                        r, "rejected_stopped",
+                        EngineStopped(f"serving: dispatch thread crashed "
+                                      f"mid-batch: {type(e).__name__}: {e}"),
+                        dispatched=True)
+            for r in leftovers:
+                if not r.future.done():
+                    self._settle_error(
+                        r, "rejected_stopped",
+                        EngineStopped(f"serving: dispatch thread crashed: "
+                                      f"{type(e).__name__}: {e}"))
+
+    def _dispatch_forever(self) -> None:
+        self._current_batch: List[_Request] = []
+        while True:
+            with self._lock:
+                while self._running and not self._queue:
+                    # periodic wake even when idle: deadline sweeps and
+                    # degradation recovery must not wait for traffic
+                    self._work.wait(timeout=0.05)
+                    self._sweep_expired_locked(time.monotonic())
+                    self._update_pressure_locked(time.monotonic())
+                if not self._running and (not self._queue or not self._drain):
+                    leftovers, self._queue = self._queue, []
+                    self._gauge_depth_locked()
+                else:
+                    leftovers = None
+                    now = time.monotonic()
+                    self._sweep_expired_locked(now)
+                    self._update_pressure_locked(now)
+                    batch = self._take_batch_locked(now)
+                    self._dispatched += len(batch)
+            if leftovers is not None:
+                for r in leftovers:
+                    self._settle_error(
+                        r, "rejected_stopped",
+                        EngineStopped("serving: engine stopped without "
+                                      "draining the queue"))
+                return
+            if batch:
+                self._current_batch = batch
+                try:
+                    self._run_batch(batch)
+                finally:
+                    self._current_batch = []
+
+    def _sweep_expired_locked(self, now: float) -> None:
+        """Expired deadlines get their typed outcome BEFORE wasting a
+        batch slot."""
+        live = []
+        for r in self._queue:
+            if r.deadline is not None and r.deadline.expired:
+                self._settle_error(
+                    r, "deadline_exceeded",
+                    DeadlineExceeded(r.deadline.what, r.deadline.budget_s,
+                                     r.deadline.elapsed()),
+                    locked=True)
+            else:
+                live.append(r)
+        if len(live) != len(self._queue):
+            self._queue[:] = live
+            self._gauge_depth_locked()
+
+    def _take_batch_locked(self, now: float) -> List[_Request]:
+        if not self._queue:
+            return []
+        sig = self._queue[0].sig
+        cap = self._cur_max_batch
+        batch, rows, rest = [], 0, []
+        for r in self._queue:
+            if r.sig == sig and rows + r.nrows <= cap:
+                batch.append(r)
+                rows += r.nrows
+            elif r.sig == sig and not batch and r.nrows > cap:
+                # admitted before degradation shrank the ceiling below its
+                # row count: dispatch it ALONE at its natural bucket — the
+                # degraded cap bounds coalescing, it must never strand an
+                # admitted request without a terminal outcome
+                batch.append(r)
+                rows += r.nrows
+            else:
+                rest.append(r)
+        if (rows < cap and self.config.batch_window_s > 0
+                and not getattr(self, "_windowed", False)):
+            # give the batch exactly one window to fill (the flag stays
+            # set through the re-take so it cannot wait twice). Submits
+            # notify the condition, so wait in a loop until the window
+            # expires or the bucket is full — an early wake must not
+            # dispatch a half-filled batch
+            self._windowed = True
+            try:
+                until = now + self.config.batch_window_s
+                while True:
+                    left = until - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._work.wait(timeout=left)
+                    if sum(r.nrows for r in self._queue
+                           if r.sig == sig) >= cap:
+                        break
+                self._sweep_expired_locked(time.monotonic())
+                return self._take_batch_locked(time.monotonic())
+            finally:
+                self._windowed = False
+        self._queue[:] = rest
+        self._gauge_depth_locked()
+        return batch
+
+    def _run_batch(self, batch: List[_Request]) -> None:
+        rows = sum(r.nrows for r in batch)
+        padded = self._bucket_size(rows)
+        sig = batch[0].sig
+        bucket = (sig, padded)
+        br = self._breakers.get(bucket)
+        if br is None:
+            br = CircuitBreaker(self.config.breaker_threshold,
+                                self.config.breaker_cooldown_s,
+                                name=self._bucket_label(bucket))
+            with self._lock:   # health() snapshots the dict concurrently
+                self._breakers[bucket] = br
+        verdict = br.allow()
+        if verdict == "no":
+            for r in batch:
+                self._settle_error(
+                    r, "circuit_open",
+                    CircuitOpen(
+                        f"serving: bucket {br.name} quarantined "
+                        f"(state={br.state}, "
+                        f"{br.snapshot()['consecutive_failures']} "
+                        f"consecutive failures)", bucket=br.name),
+                    dispatched=True)
+            self._gauge_open_buckets()
+            return
+        try:
+            _faults.fault_point("batch_dispatch")
+            feed = self._pad_feed(batch, rows, padded)
+            t0 = time.perf_counter()
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_names,
+                                 scope=self._scope)
+            batch_s = time.perf_counter() - t0
+        except Exception as e:   # typed per-batch isolation; engine lives
+            br.record_failure()
+            self._gauge_open_buckets()
+            if _monitor.enabled():
+                _monitor.counter(
+                    "serving_batches_total",
+                    "dispatched batches by result").labels(
+                    result="failed").inc()
+            logger.warning(
+                "serving: batch of %d request(s) on bucket %s failed "
+                "(%s: %s) — failing those requests, engine continues",
+                len(batch), self._bucket_label(bucket), type(e).__name__, e)
+            for r in batch:
+                # one instance per future: concurrent result() raises
+                # would otherwise interleave __traceback__ on a shared
+                # exception object
+                err = BatchFailed(
+                    f"serving: batch failed on bucket "
+                    f"{self._bucket_label(bucket)}: "
+                    f"{type(e).__name__}: {e}")
+                err.__cause__ = e
+                self._settle_error(r, "failed", err, dispatched=True)
+            return
+        br.record_success()
+        self._gauge_open_buckets()
+        if _monitor.enabled():
+            _monitor.counter("serving_batches_total",
+                             "dispatched batches by result").labels(
+                result="ok").inc()
+            _monitor.histogram(
+                "serving_batch_occupancy",
+                "real rows / padded bucket rows per dispatched batch",
+                buckets=OCCUPANCY_BUCKETS).observe(rows / padded)
+            _monitor.histogram(
+                "serving_batch_seconds",
+                "wall time of one dispatched serving batch").observe(
+                batch_s)
+        self._distribute(batch, outs, padded)
+
+    def _distribute(self, batch, outs, padded) -> None:
+        now = time.monotonic()
+        offset = 0
+        for r in batch:
+            res = []
+            for o in outs:
+                a = np.asarray(o)
+                if a.ndim and a.shape[0] == padded:
+                    res.append(a[offset:offset + r.nrows])
+                else:
+                    # batch-invariant fetch (scalar/aggregate): every
+                    # request gets the full value
+                    res.append(a)
+            offset += r.nrows
+            if r.deadline is not None and r.deadline.expired:
+                # the batch outran the request's budget (e.g. a cold
+                # bucket compile): the documented contract is a typed
+                # DeadlineExceeded, never a stale late response
+                self._settle_error(
+                    r, "deadline_exceeded",
+                    DeadlineExceeded(r.deadline.what, r.deadline.budget_s,
+                                     r.deadline.elapsed()),
+                    dispatched=True)
+                continue
+            latency = now - r.submitted
+            with self._lock:
+                self._acct["completed"] += 1
+                self._dispatched -= 1
+            self._record_outcome("completed")
+            if _monitor.enabled():
+                _monitor.histogram(
+                    "serving_request_latency_seconds",
+                    "submit-to-response latency of completed requests "
+                    "(p50/p99 in the snapshot)").observe(latency)
+            r.future._settle(result=res)
+
+    # -- helpers ---------------------------------------------------------
+    def _bucket_size(self, rows: int) -> int:
+        p = 1
+        while p < rows:
+            p <<= 1
+        return min(p, self.config.max_batch)
+
+    @staticmethod
+    def _bucket_label(bucket) -> str:
+        sig, padded = bucket
+        shapes = ",".join(f"{n}[{'x'.join(map(str, s))}:{d}]"
+                          for n, s, d in sig)
+        return f"b{padded}({shapes})"
+
+    def _pad_feed(self, batch, rows, padded) -> Dict[str, np.ndarray]:
+        feed = {}
+        for n in self._feed_names:
+            parts = [r.feed[n] for r in batch]
+            if padded > rows:
+                pad = np.zeros((padded - rows,) + parts[0].shape[1:],
+                               dtype=parts[0].dtype)
+                parts = parts + [pad]
+            feed[n] = np.concatenate(parts, axis=0) if len(parts) > 1 \
+                else parts[0]
+        return feed
+
+    def _settle_error(self, r: _Request, key: str, err: BaseException,
+                      locked: bool = False, dispatched: bool = False) -> None:
+        """``dispatched``: the request had been taken off the queue (its
+        batch executed), so the in-flight count must drop with it."""
+        if locked:
+            self._acct[key] += 1
+            if dispatched:
+                self._dispatched -= 1
+        else:
+            with self._lock:
+                self._acct[key] += 1
+                if dispatched:
+                    self._dispatched -= 1
+        self._record_outcome(key)
+        r.future._settle(error=err)
+
+    def _account(self, key: str) -> None:
+        with self._lock:
+            self._acct[key] += 1
+        self._record_outcome(key)
+
+    @staticmethod
+    def _record_outcome(outcome: str) -> None:
+        if _monitor.enabled():
+            _monitor.counter(
+                "serving_requests_total",
+                "request terminal outcomes (exactly one per submitted "
+                "request)").labels(outcome=outcome).inc()
+            if outcome == "deadline_exceeded":
+                _monitor.counter(
+                    "serving_deadline_exceeded_total",
+                    "requests that expired before a response").inc()
+
+    def _gauge_depth_locked(self) -> None:
+        if _monitor.enabled():
+            _monitor.gauge("serving_queue_depth",
+                           "requests waiting for dispatch").set(
+                len(self._queue))
+
+    def _gauge_open_buckets(self) -> None:
+        if _monitor.enabled():
+            with self._lock:
+                breakers = list(self._breakers.values())
+            _monitor.gauge(
+                "serving_breaker_open_buckets",
+                "shape buckets currently quarantined").set(
+                sum(1 for b in breakers if b.state != "closed"))
+
+    # -- observability ---------------------------------------------------
+    def warm_up(self, batch_sizes: Optional[Sequence[int]] = None) -> int:
+        """Pre-compile the power-of-two buckets with zero feeds built
+        from the program's declared var shapes, so first real traffic
+        never pays a compile. Returns the number of buckets compiled.
+        Call before ``start()`` (or any time — the step cache absorbs
+        duplicates)."""
+        from ..core.types import np_dtype
+
+        if batch_sizes is None:
+            batch_sizes, b = [], 1
+            while b < self.config.max_batch:
+                batch_sizes.append(b)
+                b <<= 1
+            # max_batch itself is always a reachable bucket (_bucket_size
+            # caps there), even when it is not a power of two
+            batch_sizes.append(self.config.max_batch)
+        blk = self._program.global_block
+        for b in batch_sizes:
+            feed = {}
+            for n in self._feed_names:
+                v = blk.var(n)
+                tail = tuple(int(d) for d in v.shape[1:])
+                feed[n] = np.zeros((int(b),) + tail, dtype=np_dtype(v.dtype))
+            self._exe.run(self._program, feed=feed,
+                          fetch_list=self._fetch_names, scope=self._scope)
+        return len(batch_sizes)
+
+    def accounting(self) -> dict:
+        """Exact request accounting: ``submitted`` equals the sum of all
+        terminal outcomes plus ``pending``. The load gate's invariant."""
+        with self._lock:
+            acct = dict(self._acct)
+            # pending = queued + taken-but-unsettled (a batch mid-flight):
+            # the invariant must hold at ANY instant, not just at idle
+            acct["pending"] = len(self._queue) + self._dispatched
+        terminal = sum(v for k, v in acct.items()
+                       if k not in ("submitted", "pending"))
+        acct["accounted"] = terminal + acct["pending"]
+        acct["exact"] = acct["accounted"] == acct["submitted"]
+        return acct
+
+    def health(self) -> dict:
+        """Liveness/pressure snapshot (wire into any HTTP layer as the
+        health probe body)."""
+        with self._lock:
+            depth = len(self._queue)
+            degraded = self._degraded
+            running = self._running
+            cur_max = self._cur_max_batch
+            breakers = list(self._breakers.values())
+        open_buckets = [b.snapshot() for b in breakers
+                        if b.state != "closed"]
+        status = ("stopped" if not running
+                  else "degraded" if degraded or open_buckets else "ok")
+        return {"status": status, "ready": self.ready(),
+                "queue_depth": depth,
+                "queue_limit": self.config.queue_depth,
+                "degraded": degraded, "current_max_batch": cur_max,
+                "open_buckets": open_buckets,
+                "accounting": self.accounting()}
+
+    def ready(self) -> bool:
+        """Readiness probe: accepting traffic and the dispatcher is
+        alive."""
+        with self._lock:
+            running = self._running
+        return bool(running and self._thread is not None
+                    and self._thread.is_alive())

@@ -400,3 +400,68 @@ def test_metrics_report_check_fails_on_forced_recompiles(tmp_path):
     assert forced["metrics"]["recompiles"] == 2
     assert "feed_signature" in str(forced["diagnostic"])
     assert data["check"]["status"] == "fail"
+
+
+# ---------------------------------------------------------------------------
+# histogram quantile estimation (serving SLOs: p50/p99)
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantiles_interpolate_within_buckets():
+    from paddle_tpu.monitor.registry import Histogram
+    import threading
+
+    h = Histogram(threading.RLock(), buckets=(1.0, 2.0, 4.0, 8.0))
+    for v in (0.5, 1.5, 1.5, 3.0, 7.0):
+        h.observe(v)
+    p50 = h.quantile(0.5)
+    assert 1.0 <= p50 <= 2.0, f"median of (0.5,1.5,1.5,3,7) ~ bucket (1,2], got {p50}"
+    p99 = h.quantile(0.99)
+    assert 4.0 <= p99 <= 7.0, "p99 lands in (4,8] but clamps to max=7"
+    # clamping: a single observation pins every quantile to itself
+    h1 = Histogram(threading.RLock(), buckets=(1.0, 2.0))
+    h1.observe(1.7)
+    assert h1.quantile(0.5) == h1.quantile(0.99) == 1.7
+
+
+def test_histogram_quantiles_empty_and_overflow():
+    from paddle_tpu.monitor.registry import Histogram
+    import threading
+
+    h = Histogram(threading.RLock(), buckets=(1.0,))
+    assert h.quantile(0.5) is None
+    with pytest.raises(ValueError):
+        h.observe(1.0) or h.quantile(0.0)
+    # +Inf bucket ranks report the observed max, not an invented bound
+    h.observe(100.0)
+    assert h.quantile(0.99) == 100.0
+
+
+def test_histogram_snapshot_carries_p50_p99():
+    monitor.reset()
+    fam = monitor.histogram("unit_latency_seconds", "t")
+    for v in (0.01, 0.02, 0.03, 0.04):
+        fam.observe(v)
+    snap = monitor.metric_value("unit_latency_seconds")
+    assert snap["count"] == 4 and snap["p50"] is not None
+    assert 0.01 <= snap["p50"] <= 0.03
+    assert snap["p50"] <= snap["p99"] <= 0.04
+
+
+def test_histogram_prometheus_exposition_conventions():
+    """_bucket/_sum/_count lines, cumulative le counts ending at +Inf —
+    what a Prometheus scraper of the serving sidecar expects."""
+    monitor.reset()
+    fam = monitor.histogram("unit_hist_seconds", "t")
+    fam.labels(path="run").observe(0.002)
+    fam.labels(path="run").observe(0.2)
+    text = monitor.get_registry().to_prometheus()
+    lines = [ln for ln in text.splitlines() if ln.startswith("unit_hist")]
+    buckets = [ln for ln in lines if "_bucket" in ln]
+    assert buckets and 'le="+Inf"' in buckets[-1]
+    assert buckets[-1].endswith(" 2"), "+Inf bucket holds the total count"
+    # cumulative: counts never decrease across the ordered buckets
+    counts = [int(float(ln.rsplit(" ", 1)[1])) for ln in buckets]
+    assert counts == sorted(counts)
+    assert any(ln.startswith("unit_hist_seconds_sum") for ln in lines)
+    assert any(ln.startswith("unit_hist_seconds_count") for ln in lines)
+    assert "# TYPE unit_hist_seconds histogram" in text

@@ -5,6 +5,7 @@ step degradation. The real-kill end-to-end lives in tools/chaos_check.py
 (CI); these tests cover the same machinery in-process."""
 import json
 import os
+import time
 
 import numpy as np
 import pytest
@@ -576,3 +577,75 @@ def test_unknown_nan_policy_rejected(flags_guard):
                  "FLAGS_nan_inf_policy": "shrug"})
     with pytest.raises(ValueError, match="nan_inf_policy"):
         s.run(_feed())
+
+
+# ---------------------------------------------------------------------------
+# the shared Deadline (resilience.deadline): one implementation for retry
+# budgets and serving request deadlines
+# ---------------------------------------------------------------------------
+
+def test_deadline_basics():
+    from paddle_tpu.resilience import Deadline, DeadlineExceeded
+
+    dl = Deadline(30.0, what="unit test")
+    assert not dl.expired
+    assert 0 < dl.remaining() <= 30.0
+    dl.check()                                   # plenty of budget: no-op
+    fast = Deadline(0.005, what="tiny")
+    time.sleep(0.02)
+    assert fast.expired and fast.remaining() < 0
+    with pytest.raises(DeadlineExceeded, match="tiny"):
+        fast.check()
+
+
+def test_deadline_unbounded_never_expires():
+    from paddle_tpu.resilience import Deadline
+
+    for budget in (None, 0, -1.0):
+        dl = Deadline(budget)
+        assert dl.remaining() is None and not dl.expired
+        dl.check()
+
+
+def test_deadline_context_manager_flags_overrun():
+    from paddle_tpu.resilience import Deadline, DeadlineExceeded
+
+    with Deadline(30.0, what="fits"):
+        pass                                     # within budget: clean
+    with pytest.raises(DeadlineExceeded, match="overran"):
+        with Deadline(0.005, what="overran"):
+            time.sleep(0.02)
+    # an in-flight exception wins over the deadline re-check
+    with pytest.raises(KeyError):
+        with Deadline(0.005, what="masked"):
+            time.sleep(0.02)
+            raise KeyError("real failure")
+
+
+def test_deadline_exceeded_is_never_transient():
+    from paddle_tpu.resilience import DeadlineExceeded, is_transient
+
+    err = DeadlineExceeded("x", 1.0, 2.0)
+    assert isinstance(err, TimeoutError)         # stdlib-compatible
+    assert not is_transient(err), \
+        "retrying an expired deadline only makes it later"
+
+
+def test_retry_budget_uses_shared_deadline(flags_guard):
+    """The per-site retry timeout is the SAME Deadline implementation:
+    a site whose budget is spent gives up even with attempts left."""
+    from paddle_tpu.resilience import RetryExhaustedError, RetryPolicy
+    from paddle_tpu.resilience.retry import call_with_retry
+
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise RuntimeError("down")
+
+    pol = RetryPolicy(max_attempts=50, base_delay=0.02, max_delay=0.02,
+                      jitter=0.0, timeout=0.05)
+    with pytest.raises(RetryExhaustedError):
+        call_with_retry("unit_site", always_down, policy=pol)
+    assert 2 <= len(calls) < 50, \
+        "the deadline, not the attempt count, must end the loop"

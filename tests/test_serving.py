@@ -1,0 +1,556 @@
+"""paddle_tpu.serving: continuous batching, admission control, deadlines,
+circuit breaker, degradation — plus the executor thread-safety regression
+the serving dispatch thread depends on.
+
+Every test drives the PUBLIC surface (submit/result/health/accounting);
+the exactly-one-terminal-outcome contract is asserted through
+``accounting()['exact']`` wherever chaos is injected."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor, serving
+from paddle_tpu.resilience import fault_plan_guard
+from paddle_tpu.resilience.deadline import Deadline, DeadlineExceeded
+
+
+@pytest.fixture(autouse=True)
+def _flags_and_plan_reset():
+    """Serving tests flip watchdog/fault flags; restore the override map
+    and drop any installed fault plan so later tests see defaults."""
+    from paddle_tpu import flags as flags_mod
+    from paddle_tpu.resilience import faults
+
+    snap = dict(flags_mod._overrides)
+    yield
+    flags_mod._overrides.clear()
+    flags_mod._overrides.update(snap)
+    faults.clear_plan()
+
+
+def _build_infer(hidden=4, in_dim=13):
+    import paddle_tpu.unique_name as un
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[in_dim], dtype="float32")
+            pred = fluid.layers.fc(x, hidden, act="softmax")
+        infer = main.clone(for_test=True)
+    return infer, startup, pred.name
+
+
+def _engine(config=None, **cfg_kw):
+    infer, startup, pred = _build_infer()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    cfg = config or serving.ServingConfig(
+        max_batch=cfg_kw.pop("max_batch", 4), **cfg_kw)
+    eng = serving.ServingEngine(infer, feed_names=["x"], fetch_list=[pred],
+                                scope=scope, executor=exe, config=cfg)
+    return eng
+
+
+def _feed(rows=1, seed=None):
+    rng = np.random.RandomState(seed if seed is not None else 0)
+    return {"x": rng.rand(rows, 13).astype(np.float32)}
+
+
+def _wait_queue_empty(eng, timeout=10.0):
+    """Block until every queued request has been TAKEN by the dispatcher
+    (dispatched or in flight — e.g. stalled in a hang), so the next
+    submit cannot coalesce into the same batch. Accounting's ``pending``
+    deliberately still counts in-flight requests, so poll the queue."""
+    until = time.monotonic() + timeout
+    while time.monotonic() < until:
+        if not eng._queue:
+            return
+        time.sleep(0.01)
+    raise AssertionError("dispatcher never drained the queue")
+
+
+# ---------------------------------------------------------------------------
+# batching into buckets
+# ---------------------------------------------------------------------------
+
+def test_requests_coalesce_into_one_padded_bucket():
+    """Three 1-row requests inside one batch window dispatch as ONE batch
+    padded to the 4-bucket, and each caller gets exactly its own rows."""
+    eng = _engine(max_batch=4, batch_window_s=0.5)
+    eng.warm_up()
+    before = monitor.metric_value("serving_batches_total", 0.0, result="ok")
+    with eng:
+        futs = [eng.submit(_feed(seed=i)) for i in range(3)]
+        outs = [f.result(timeout=30) for f in futs]
+    assert all(o[0].shape == (1, 4) for o in outs)
+    got = monitor.metric_value("serving_batches_total", 0.0, result="ok")
+    assert got - before == 1, "3 requests inside one window must be 1 batch"
+    occ = monitor.metric_value("serving_batch_occupancy")
+    assert occ["count"] >= 1 and abs(occ["max"] - 0.75) < 1e-6  # 3 rows / 4
+
+
+def test_batched_results_match_direct_execution():
+    """Padding + slicing must be invisible: a request's rows equal what a
+    direct exe.run of just that request returns."""
+    eng = _engine(max_batch=8, batch_window_s=0.3)
+    with eng:
+        feeds = [_feed(rows=r, seed=i) for i, r in enumerate((1, 2, 1))]
+        futs = [eng.submit(f) for f in feeds]
+        outs = [f.result(timeout=30) for f in futs]
+    for f, o in zip(feeds, outs):
+        direct = eng._exe.run(eng._program, feed=f,
+                              fetch_list=eng._fetch_names, scope=eng._scope)
+        np.testing.assert_allclose(o[0], direct[0], rtol=1e-5, atol=1e-6)
+
+
+def test_distinct_shapes_land_in_distinct_buckets():
+    """Different example shapes never share a batch; both succeed."""
+    infer, startup, pred = _build_infer()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    eng = serving.ServingEngine(infer, feed_names=["x"], fetch_list=[pred],
+                                scope=scope, executor=exe,
+                                config=serving.ServingConfig(max_batch=4))
+    with eng:
+        f1 = eng.submit({"x": np.zeros((1, 13), np.float32)})
+        f2 = eng.submit({"x": np.zeros((2, 13), np.float32)})
+        r1, r2 = f1.result(timeout=30), f2.result(timeout=30)
+    assert r1[0].shape == (1, 4) and r2[0].shape == (2, 4)
+
+
+def test_warm_up_precompiles_every_bucket():
+    eng = _engine(max_batch=4)
+    misses0 = monitor.metric_value("executor_cache_lookups_total", 0.0,
+                                   path="run", result="miss")
+    assert eng.warm_up() == 3   # buckets 1, 2, 4
+    misses1 = monitor.metric_value("executor_cache_lookups_total", 0.0,
+                                   path="run", result="miss")
+    assert misses1 - misses0 == 3
+    with eng:
+        assert eng.submit(_feed()).result(timeout=30)[0].shape == (1, 4)
+    misses2 = monitor.metric_value("executor_cache_lookups_total", 0.0,
+                                   path="run", result="miss")
+    assert misses2 == misses1, "warmed bucket must be a cache hit"
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+def test_deadline_expiry_is_typed_and_swept():
+    """A queued request whose deadline passes while a hang occupies the
+    dispatcher gets DeadlineExceeded, not a stale late response."""
+    fluid.set_flags({"FLAGS_step_timeout_s": 2.0,
+                     "FLAGS_watchdog_hard_exit": 0})
+    eng = _engine(max_batch=4)
+    eng.warm_up()
+    with eng, fault_plan_guard("hang:@1:hang"):
+        f_hang = eng.submit(_feed())
+        _wait_queue_empty(eng)    # the hang batch must dispatch alone
+        f_dead = eng.submit(_feed(), deadline_s=0.3)
+        err_hang = f_hang.exception(timeout=60)
+        err_dead = f_dead.exception(timeout=60)
+    assert isinstance(err_dead, DeadlineExceeded)
+    assert isinstance(err_hang, serving.BatchFailed)
+    acct = eng.accounting()
+    assert acct["exact"] and acct["deadline_exceeded"] == 1
+
+
+def test_default_deadline_from_config():
+    """submit() without deadline_s inherits the config default: with a
+    1 ms default and a hang occupying the dispatcher, at least one
+    request must expire typed — proof the default applied at all."""
+    eng = _engine(max_batch=4, deadline_s=0.001)
+    fluid.set_flags({"FLAGS_step_timeout_s": 2.0,
+                     "FLAGS_watchdog_hard_exit": 0})
+    eng.warm_up()
+    with eng, fault_plan_guard("hang:@1:hang"):
+        f1 = eng.submit(_feed())
+        _wait_queue_empty(eng)
+        f2 = eng.submit(_feed())
+        errs = [f1.exception(timeout=60), f2.exception(timeout=60)]
+    assert any(isinstance(e, DeadlineExceeded) for e in errs), errs
+    acct = eng.accounting()
+    assert acct["exact"] and acct["deadline_exceeded"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# admission control / shedding
+# ---------------------------------------------------------------------------
+
+def test_full_queue_sheds_typed_overloaded():
+    fluid.set_flags({"FLAGS_step_timeout_s": 2.0,
+                     "FLAGS_watchdog_hard_exit": 0})
+    eng = _engine(max_batch=1, queue_depth=2)
+    eng.warm_up()
+    with eng, fault_plan_guard("hang:@1:hang"):
+        futs = [eng.submit(_feed())]          # dispatched, hangs
+        _wait_queue_empty(eng)
+        futs += [eng.submit(_feed()), eng.submit(_feed())]  # queue full
+        with pytest.raises(serving.Overloaded) as ei:
+            eng.submit(_feed())
+        assert ei.value.reason == "queue_full"
+        for f in futs:
+            f.exception(timeout=60)            # all settle eventually
+    acct = eng.accounting()
+    assert acct["exact"] and acct["shed"] == 1
+    assert monitor.metric_value("serving_shed_total", 0.0,
+                                reason="queue_full") >= 1
+
+
+def test_injected_overload_site_forces_shed():
+    eng = _engine(max_batch=4)
+    with eng, fault_plan_guard("overload:1:RuntimeError"):
+        with pytest.raises(serving.Overloaded) as ei:
+            eng.submit(_feed())
+        assert ei.value.reason == "injected"
+        # next request sails through
+        assert eng.submit(_feed()).result(timeout=30)[0].shape == (1, 4)
+    assert eng.accounting()["exact"]
+
+
+def test_enqueue_fault_is_typed_submission_failure():
+    from paddle_tpu.resilience.faults import InjectedFault
+
+    eng = _engine(max_batch=4)
+    with eng, fault_plan_guard("enqueue:1:RuntimeError"):
+        with pytest.raises(InjectedFault):
+            eng.submit(_feed())
+        assert eng.submit(_feed()).result(timeout=30)[0].shape == (1, 4)
+    acct = eng.accounting()
+    assert acct["exact"] and acct["rejected_fault"] == 1
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_open_halfopen_close_cycle():
+    eng = _engine(max_batch=4, breaker_threshold=2, breaker_cooldown_s=0.2)
+    eng.warm_up()
+    with eng:
+        with fault_plan_guard("batch_dispatch:2:RuntimeError"):
+            for _ in range(2):
+                err = eng.submit(_feed()).exception(timeout=30)
+                assert isinstance(err, serving.BatchFailed)
+        # open: immediate rejection, no dispatch
+        err = eng.submit(_feed()).exception(timeout=30)
+        assert isinstance(err, serving.CircuitOpen)
+        assert eng.health()["status"] == "degraded"
+        assert [b["state"] for b in eng.health()["open_buckets"]] == ["open"]
+        # past cooldown: half-open probe succeeds and closes
+        time.sleep(0.6)
+        out = eng.submit(_feed()).result(timeout=30)
+        assert out[0].shape == (1, 4)
+        assert eng.health()["status"] == "ok"
+        assert eng.health()["open_buckets"] == []
+    acct = eng.accounting()
+    assert acct["exact"] and acct["circuit_open"] == 1 \
+        and acct["failed"] == 2
+    assert monitor.metric_value("serving_breaker_transitions_total", 0.0,
+                                to="closed") >= 1
+
+
+def test_breaker_failed_probe_reopens():
+    eng = _engine(max_batch=4, breaker_threshold=1, breaker_cooldown_s=0.1)
+    eng.warm_up()
+    with eng:
+        with fault_plan_guard("batch_dispatch:2:RuntimeError"):
+            err = eng.submit(_feed()).exception(timeout=30)   # opens
+            assert isinstance(err, serving.BatchFailed)
+            time.sleep(0.3)
+            err = eng.submit(_feed()).exception(timeout=30)   # probe fails
+            assert isinstance(err, serving.BatchFailed)
+        assert [b["state"] for b in eng.health()["open_buckets"]] == ["open"]
+        # the re-open cooldown backs off (retry schedule): wait longer
+        time.sleep(1.0)
+        assert eng.submit(_feed()).result(timeout=30)[0].shape == (1, 4)
+    assert eng.accounting()["exact"]
+
+
+def test_breaker_isolation_other_bucket_keeps_serving():
+    """A quarantined bucket must not affect a different shape bucket."""
+    eng = _engine(max_batch=4, breaker_threshold=1,
+                  breaker_cooldown_s=30.0)
+    eng.warm_up()
+    with eng:
+        with fault_plan_guard("batch_dispatch:1:RuntimeError"):
+            eng.submit(_feed(rows=1)).exception(timeout=30)
+        err = eng.submit(_feed(rows=1)).exception(timeout=30)
+        assert isinstance(err, serving.CircuitOpen)
+        # 2-row requests land in the b2 bucket: unaffected
+        assert eng.submit(_feed(rows=2)).result(timeout=30)[0].shape == (2, 4)
+    assert eng.accounting()["exact"]
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation + recovery
+# ---------------------------------------------------------------------------
+
+def test_degradation_sheds_priority_and_recovers():
+    eng = _engine(max_batch=4, queue_depth=2, batch_window_s=0.4,
+                  degrade_after_s=0.0, recover_after_s=0.05,
+                  degraded_min_priority=1)
+    eng.warm_up()
+    with eng:
+        f1 = eng.submit(_feed(), priority=5)
+        # dispatcher holds f1 in its batch window; depth >= 3/4*2 -> 1 is
+        # pressure, degrade_after 0 -> the NEXT admission degrades
+        f2 = eng.submit(_feed(), priority=5)
+        deadline = time.monotonic() + 2.0
+        degraded = False
+        while time.monotonic() < deadline and not degraded:
+            degraded = eng.health()["degraded"]
+            if not degraded:
+                time.sleep(0.02)
+        assert degraded, "sustained pressure must enter degraded mode"
+        assert eng.health()["current_max_batch"] == 2
+        with pytest.raises(serving.Overloaded) as ei:
+            eng.submit(_feed(), priority=0)    # below min priority
+        assert ei.value.reason == "priority"
+        # high-priority traffic still admitted while degraded
+        f3 = eng.submit(_feed(), priority=3)
+        for f in (f1, f2, f3):
+            assert f.result(timeout=30)[0].shape == (1, 4)
+        # pressure cleared: recovery restores the full ceiling
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and eng.health()["degraded"]:
+            time.sleep(0.05)
+        assert not eng.health()["degraded"]
+        assert eng.health()["current_max_batch"] == 4
+        assert eng.submit(_feed(), priority=0).result(timeout=30)
+    acct = eng.accounting()
+    assert acct["exact"] and acct["shed"] == 1
+    assert monitor.metric_value("serving_degradations_total", 0.0) >= 1
+
+
+def test_degraded_mode_still_dispatches_oversized_requests():
+    """A request wider than the degraded batch ceiling (but within
+    max_batch) must dispatch alone, never strand without an outcome."""
+    eng = _engine(max_batch=4, queue_depth=2, batch_window_s=0.4,
+                  degrade_after_s=0.0, recover_after_s=30.0,
+                  degraded_min_priority=1)
+    eng.warm_up()
+    with eng:
+        f1 = eng.submit(_feed(), priority=5)
+        f2 = eng.submit(_feed(), priority=5)   # pressure -> degraded
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not eng.health()["degraded"]:
+            time.sleep(0.02)
+        assert eng.health()["degraded"]
+        assert eng.health()["current_max_batch"] == 2
+        f3 = eng.submit(_feed(rows=3), priority=5)   # 3 > degraded cap 2
+        assert f3.result(timeout=30)[0].shape == (3, 4)
+        for f in (f1, f2):
+            f.result(timeout=30)
+    assert eng.accounting()["exact"]
+
+
+# ---------------------------------------------------------------------------
+# negative control: clean traffic has a clean ledger
+# ---------------------------------------------------------------------------
+
+def test_no_faults_zero_sheds_zero_rejections():
+    eng = _engine(max_batch=4)
+    eng.warm_up()
+    with eng:
+        futs = [eng.submit(_feed(seed=i)) for i in range(20)]
+        outs = [f.result(timeout=60) for f in futs]
+    assert len(outs) == 20
+    acct = eng.accounting()
+    assert acct == {"submitted": 20, "completed": 20, "failed": 0,
+                    "shed": 0, "deadline_exceeded": 0, "circuit_open": 0,
+                    "rejected_fault": 0, "rejected_stopped": 0,
+                    "pending": 0, "accounted": 20, "exact": True}
+    assert eng.health()["open_buckets"] == []
+    assert not eng.health()["degraded"]
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+def test_stop_without_drain_fails_queued_typed():
+    fluid.set_flags({"FLAGS_step_timeout_s": 2.0,
+                     "FLAGS_watchdog_hard_exit": 0})
+    eng = _engine(max_batch=1)
+    eng.warm_up()
+    with fault_plan_guard("hang:@1:hang"):
+        eng.start()
+        f1 = eng.submit(_feed())
+        _wait_queue_empty(eng)
+        f2 = eng.submit(_feed())
+        eng.stop(drain=False, timeout=60)
+        assert isinstance(f1.exception(timeout=60), serving.BatchFailed)
+        assert isinstance(f2.exception(timeout=60), serving.EngineStopped)
+    with pytest.raises(serving.EngineStopped):
+        eng.submit(_feed())
+    assert eng.accounting()["exact"]
+    assert not eng.ready()
+
+
+def test_submit_before_start_is_typed():
+    eng = _engine(max_batch=4)
+    with pytest.raises(serving.EngineStopped):
+        eng.submit(_feed())
+    assert eng.accounting()["exact"]
+
+
+def test_malformed_feed_never_enters_accounting():
+    eng = _engine(max_batch=4)
+    with eng:
+        with pytest.raises(ValueError):
+            eng.submit({})                      # empty
+        with pytest.raises(ValueError):
+            eng.submit({"wrong": np.zeros((1, 13), np.float32)})
+        with pytest.raises(ValueError):
+            eng.submit({"x": np.zeros((99, 13), np.float32)})  # > max_batch
+    assert eng.accounting()["submitted"] == 0
+
+
+# ---------------------------------------------------------------------------
+# watchdog: slow batch in the (non-main) dispatch thread dies diagnosed
+# ---------------------------------------------------------------------------
+
+def test_hang_in_dispatch_thread_dies_under_watchdog():
+    from paddle_tpu.resilience.distributed import WatchdogTimeout
+
+    fluid.set_flags({"FLAGS_step_timeout_s": 1.0,
+                     "FLAGS_watchdog_hard_exit": 0})
+    eng = _engine(max_batch=4, breaker_threshold=10)
+    eng.warm_up()
+    with eng, fault_plan_guard("hang:@1:hang"):
+        t0 = time.monotonic()
+        fut = eng.submit(_feed())
+        _wait_queue_empty(eng)
+        # invariant holds mid-flight too: the hung request is pending
+        mid = eng.accounting()
+        assert mid["exact"] and mid["pending"] == 1
+        err = fut.exception(timeout=60)
+        took = time.monotonic() - t0
+        assert isinstance(err, serving.BatchFailed)
+        assert isinstance(err.__cause__, WatchdogTimeout)
+        assert took < 30, "hang must die at the deadline, not ride it out"
+        # engine survives and keeps serving
+        assert eng.submit(_feed()).result(timeout=30)[0].shape == (1, 4)
+    acct = eng.accounting()
+    assert acct["exact"] and acct["failed"] == 1 and acct["completed"] == 1
+    assert monitor.metric_value("watchdog_timeouts_total", 0.0,
+                                section="step") >= 1
+
+
+def test_watchdog_interrupts_plain_worker_thread():
+    """The distributed-layer primitive itself: a section armed in a
+    non-main thread is broken with a typed WatchdogTimeout."""
+    from paddle_tpu.resilience import distributed as dist
+
+    fluid.set_flags({"FLAGS_watchdog_hard_exit": 0})
+    out = {}
+
+    def body():
+        try:
+            with dist.watchdog_section("step", timeout=0.5):
+                while True:
+                    time.sleep(0.02)
+        except dist.WatchdogTimeout as e:
+            out["err"] = e
+        except BaseException as e:   # pragma: no cover - diagnosis aid
+            out["err"] = e
+
+    t = threading.Thread(target=body)
+    t.start()
+    t.join(30)
+    assert not t.is_alive(), "watchdog failed to break the worker thread"
+    assert isinstance(out.get("err"), dist.WatchdogTimeout)
+
+
+# ---------------------------------------------------------------------------
+# executor thread-safety regression (the satellite serving depends on)
+# ---------------------------------------------------------------------------
+
+def test_two_threads_distinct_scopes_no_cache_corruption():
+    """Two threads hammer ONE executor + ONE program against their own
+    scopes: no exceptions, finite results, and exactly one step-cache
+    entry per scope serial."""
+    import paddle_tpu.unique_name as un
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[13], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(7)
+    feed = {"x": rng.rand(8, 13).astype(np.float32),
+            "y": rng.rand(8, 1).astype(np.float32)}
+    results, errors = {}, []
+
+    def worker(tid):
+        try:
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(startup, scope=scope)
+            vals = []
+            for _ in range(12):
+                out = exe.run(main, feed=feed, fetch_list=[loss],
+                              scope=scope)
+                vals.append(float(out[0]))
+            results[tid] = vals
+        except BaseException as e:
+            errors.append((tid, e))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    assert not errors, f"concurrent executor runs failed: {errors}"
+    assert len(results) == 2
+    for vals in results.values():
+        assert all(np.isfinite(v) for v in vals)
+        assert vals[-1] < vals[0], "training must still make progress"
+    # one training-step cache entry per scope (startup adds its own pair)
+    scope_serials = {k[3] for k in exe._cache if isinstance(k[3], int)}
+    assert len(exe._cache) == 4 and len(scope_serials) == 2
+
+
+def test_scope_concurrent_set_find():
+    scope = fluid.Scope()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            scope.set_var(f"v{i % 50}", np.full((4,), i))
+            i += 1
+
+    def reader():
+        try:
+            while not stop.is_set():
+                for i in range(50):
+                    v = scope.find_var(f"v{i}")
+                    if v is not None:
+                        np.asarray(v)
+        except BaseException as e:
+            errors.append(e)
+
+    ts = [threading.Thread(target=writer), threading.Thread(target=reader)]
+    for t in ts:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in ts:
+        t.join(10)
+    assert not errors

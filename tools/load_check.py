@@ -1,0 +1,336 @@
+#!/usr/bin/env python
+"""Serving load generator + CI robustness gate (``paddle_tpu.serving``).
+
+Drives ResNet-tiny and BERT-tiny inference traffic through a
+:class:`ServingEngine` from concurrent submitter threads, then a CHAOS leg
+that injects overload pressure, transient compile faults and one
+slow-batch hang (armed under the step watchdog). The gate proves the
+serving contract end to end:
+
+* **exact accounting** — every submitted request reaches exactly one
+  terminal outcome (response or typed rejection); zero silent drops, on
+  every leg including chaos;
+* **shedding works** — under overload pressure admission control sheds
+  with typed ``Overloaded`` (the chaos leg requires ``shed > 0``);
+* **faults are absorbed or isolated** — injected transient compile
+  faults are retried away (``resilience_retries_total`` grows); the hang
+  dies diagnosed under the watchdog (``watchdog_timeouts_total`` grows,
+  the batch fails typed, the engine keeps serving);
+* **SLOs are measurable** — the JSON artifact carries the full
+  ``serving_request_latency_seconds`` histogram with estimated p50/p99.
+
+Usage:
+  python tools/load_check.py                 # full legs, prints summary
+  python tools/load_check.py --ci --json ci_serving_report.json
+      CI gate: tiny probes; exit 1 on any missed requirement.
+  python tools/load_check.py --ci --negative-control
+      Disables admission control (unbounded queue, no age bound) and
+      re-runs the overload leg: with shedding off the gate MUST fail
+      (``shed == 0`` under pressure) — CI asserts the non-zero exit.
+
+Failure modes and flag table: docs/SERVING.md.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import monitor, serving  # noqa: E402
+from paddle_tpu.resilience import fault_plan_guard  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# model probes
+# ---------------------------------------------------------------------------
+
+def _resnet_engine(ci: bool, config: serving.ServingConfig):
+    from paddle_tpu.models.resnet import build_resnet
+    import paddle_tpu.unique_name as un
+
+    with un.guard():
+        shape = (3, 16, 16) if ci else (3, 32, 32)
+        net = build_resnet(depth=18, class_num=10, image_shape=shape,
+                           build_optimizer=False)
+        infer = net["main"].clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(net["startup"], scope=scope)
+    eng = serving.ServingEngine(
+        infer, feed_names=["img", "label"],
+        fetch_list=[net["logits"].name], scope=scope, executor=exe,
+        config=config)
+
+    def feed(rows=1, seed=0):
+        rng = np.random.RandomState(seed)
+        return {"img": rng.rand(rows, *shape).astype(np.float32),
+                "label": np.zeros((rows, 1), np.int64)}
+
+    return eng, feed
+
+
+def _bert_engine(ci: bool, config: serving.ServingConfig):
+    from paddle_tpu.models.bert import BertConfig, build_bert_pretrain
+    import paddle_tpu.unique_name as un
+
+    with un.guard():
+        seq = 16 if ci else 32
+        net = build_bert_pretrain(BertConfig.tiny(), seq_len=seq,
+                                  build_optimizer=False, is_test=True)
+        infer = net["main"].clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(net["startup"], scope=scope)
+    eng = serving.ServingEngine(
+        infer, feed_names=list(net["feeds"]),
+        fetch_list=[net["loss"].name], scope=scope, executor=exe,
+        config=config)
+
+    def feed(rows=1, seed=0):
+        rng = np.random.RandomState(seed)
+        return {
+            "src_ids": rng.randint(0, 1024, (rows, seq)).astype(np.int64),
+            "pos_ids": np.tile(np.arange(seq, dtype=np.int64), (rows, 1)),
+            "sent_ids": np.zeros((rows, seq), np.int64),
+            "input_mask": np.ones((rows, seq), np.float32),
+            "mask_label": np.full((rows, seq), -100, np.int64),
+            "next_sent_label": np.zeros((rows, 1), np.int64),
+        }
+
+    return eng, feed
+
+
+# ---------------------------------------------------------------------------
+# traffic
+# ---------------------------------------------------------------------------
+
+def _drive(eng, feed_fn, n_requests, n_threads, rows_cycle=(1, 2),
+           deadline_s=None, stagger_s=0.0):
+    """Submit ``n_requests`` from ``n_threads`` threads and wait for every
+    terminal outcome. Returns per-outcome counts as seen by CALLERS —
+    cross-checked against the engine's own ledger by the gate."""
+    seen = {"completed": 0, "overloaded": 0, "deadline": 0,
+            "batch_failed": 0, "circuit_open": 0, "injected": 0,
+            "stopped": 0, "other_error": 0}
+    lock = threading.Lock()
+    futures = []
+
+    def note(key):
+        with lock:
+            seen[key] += 1
+
+    def submitter(tid):
+        for i in range(tid, n_requests, n_threads):
+            rows = rows_cycle[i % len(rows_cycle)]
+            try:
+                fut = eng.submit(feed_fn(rows=rows, seed=i),
+                                 deadline_s=deadline_s,
+                                 priority=i % 3)
+                with lock:
+                    futures.append(fut)
+            except serving.Overloaded:
+                note("overloaded")
+            except serving.EngineStopped:
+                note("stopped")
+            except Exception as e:
+                from paddle_tpu.resilience.faults import InjectedFault
+
+                note("injected" if isinstance(e, InjectedFault)
+                     else "other_error")
+            if stagger_s:
+                time.sleep(stagger_s)
+
+    threads = [threading.Thread(target=submitter, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    for fut in futures:
+        err = fut.exception(timeout=600)
+        if err is None:
+            note("completed")
+        elif isinstance(err, serving.DeadlineExceeded):
+            note("deadline")
+        elif isinstance(err, serving.BatchFailed):
+            note("batch_failed")
+        elif isinstance(err, serving.CircuitOpen):
+            note("circuit_open")
+        elif isinstance(err, serving.EngineStopped):
+            note("stopped")
+        else:
+            note("other_error")
+    seen["submitted"] = n_requests
+    seen["terminal"] = sum(v for k, v in seen.items()
+                           if k not in ("submitted", "terminal"))
+    return seen
+
+
+def _latency_snapshot():
+    snap = monitor.metric_value("serving_request_latency_seconds",
+                                default=None)
+    if not isinstance(snap, dict):
+        return None
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# legs
+# ---------------------------------------------------------------------------
+
+def leg_steady(name, make_engine, ci):
+    cfg = serving.ServingConfig(max_batch=4, queue_depth=64,
+                                batch_window_s=0.01)
+    eng, feed = make_engine(ci, cfg)
+    eng.warm_up()
+    n = 24 if ci else 96
+    with eng:
+        seen = _drive(eng, feed, n_requests=n, n_threads=3)
+    acct = eng.accounting()
+    ok = (acct["exact"] and seen["terminal"] == seen["submitted"]
+          and seen["completed"] == n and acct["shed"] == 0
+          and acct["failed"] == 0 and acct["deadline_exceeded"] == 0)
+    return {"name": name, "ok": ok, "requests": n, "caller_view": seen,
+            "engine_accounting": acct,
+            "why": "all requests completed, zero sheds/failures "
+                   "(negative control for the chaos leg)"}
+
+
+def leg_chaos(name, make_engine, ci, shedding=True):
+    """Overload + transient compile faults + one watchdog-diagnosed hang.
+    ``shedding=False`` is the --negative-control variant: admission
+    control is effectively disabled, so the gate's ``shed > 0``
+    requirement MUST fail."""
+    retries0 = monitor.metric_value("resilience_retries_total", 0.0,
+                                    site="compile")
+    wd0 = monitor.metric_value("watchdog_timeouts_total", 0.0,
+                               section="step")
+    cfg = serving.ServingConfig(
+        max_batch=4,
+        queue_depth=8 if shedding else 100_000,
+        queue_age_s=5.0 if shedding else 0.0,
+        degrade_after_s=0.2 if shedding else 1e9,
+        recover_after_s=0.2, degraded_min_priority=1,
+        breaker_threshold=3, breaker_cooldown_s=0.2)
+    eng, feed = make_engine(ci, cfg)
+    # transient compile faults during warm-up: the retry/backoff at the
+    # compile site must absorb them (no caller ever sees one)
+    with fault_plan_guard("compile:2:RuntimeError"):
+        eng.warm_up()
+    fluid.set_flags({"FLAGS_step_timeout_s": 2.0,
+                     "FLAGS_watchdog_hard_exit": 0})
+    n = 48 if ci else 160
+    try:
+        # one slow-batch hang (watchdog must break it, typed) + synthetic
+        # overload pressure on top of the real burst
+        plan = "hang:@2:hang" + (",overload:2:RuntimeError"
+                                 if shedding else "")
+        with eng, fault_plan_guard(plan):
+            seen = _drive(eng, feed, n_requests=n, n_threads=4,
+                          deadline_s=8.0)
+    finally:
+        fluid.set_flags({"FLAGS_step_timeout_s": 0.0})
+    acct = eng.accounting()
+    retries = monitor.metric_value("resilience_retries_total", 0.0,
+                                   site="compile") - retries0
+    wd = monitor.metric_value("watchdog_timeouts_total", 0.0,
+                              section="step") - wd0
+    shed_total = acct["shed"]
+    checks = {
+        "exact_accounting": bool(acct["exact"]),
+        "every_submit_terminal": seen["terminal"] == seen["submitted"],
+        "no_untyped_errors": seen["other_error"] == 0,
+        "progress_under_chaos": seen["completed"] > 0,
+        "hang_died_diagnosed": wd >= 1,
+        "hang_batch_failed_typed": acct["failed"] >= 1,
+        "compile_faults_retried": retries >= 2,
+        "overload_was_shed": shed_total > 0,
+        "engine_still_healthy": acct["pending"] == 0,
+    }
+    return {"name": name, "ok": all(checks.values()), "requests": n,
+            "caller_view": seen, "engine_accounting": acct,
+            "checks": checks,
+            "watchdog_timeouts": wd, "compile_retries": retries,
+            "why": "typed outcomes for 100% of submissions under "
+                   "overload + compile faults + a watchdog-broken hang"}
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ci", action="store_true",
+                    help="tiny probes + gate checks (the CI mode)")
+    ap.add_argument("--check", action="store_true",
+                    help="alias for --ci (sibling-tool convention)")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the serving report artifact")
+    ap.add_argument("--negative-control", action="store_true",
+                    help="disable admission control; the gate must FAIL")
+    ap.add_argument("--skip-bert", action="store_true",
+                    help="resnet legs only (debugging)")
+    args = ap.parse_args(argv)
+    ci = args.ci or args.check
+
+    monitor.reset()
+    legs = []
+    t0 = time.time()
+    if args.negative_control:
+        # only the chaos leg matters: with shedding disabled the
+        # overload_was_shed requirement must trip the gate
+        legs.append(leg_chaos("chaos_resnet_no_shedding", _resnet_engine,
+                              ci, shedding=False))
+    else:
+        legs.append(leg_steady("steady_resnet", _resnet_engine, ci))
+        if not args.skip_bert:
+            legs.append(leg_steady("steady_bert", _bert_engine, ci))
+        legs.append(leg_chaos("chaos_resnet", _resnet_engine, ci))
+
+    latency = _latency_snapshot()
+    gate_ok = all(l["ok"] for l in legs) and latency is not None \
+        and latency["count"] > 0 and latency["p50"] is not None \
+        and latency["p99"] is not None
+
+    for l in legs:
+        status = "ok" if l["ok"] else "MISS"
+        print(f"[{status}] {l['name']}: {l['requests']} requests -> "
+              + ", ".join(f"{k}={v}" for k, v in
+                          sorted(l["caller_view"].items()) if v))
+        for k, v in sorted(l.get("checks", {}).items()):
+            if not v:
+                print(f"       FAILED check: {k}")
+    if latency:
+        print(f"latency: count={latency['count']} "
+              f"p50={latency['p50'] * 1e3:.1f}ms "
+              f"p99={latency['p99'] * 1e3:.1f}ms "
+              f"max={latency['max'] * 1e3:.1f}ms")
+    print(f"serving gate ({time.time() - t0:.1f}s) -> "
+          f"{'ok' if gate_ok else 'FAIL'}")
+
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump({
+                "legs": legs,
+                "latency_histogram": latency,
+                "snapshot": monitor.snapshot(),
+                "check": {"status": "ok" if gate_ok else "fail",
+                          "negative_control": bool(args.negative_control)},
+            }, f, indent=2, default=str)
+        print(f"serving artifact written to {args.json}")
+    return 0 if gate_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

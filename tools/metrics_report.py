@@ -236,6 +236,27 @@ def main(argv=None) -> int:
         for k, wg in r["missed"].items():
             print(f"       expected {k}={wg['want']}, got {wg['got']}")
 
+    # histogram SLO summary: the registry snapshots now carry estimated
+    # p50/p99 (serving latency reads the same fields in load_check)
+    for fam in monitor.get_registry().families():
+        if fam.kind != "histogram":
+            continue
+        # only *_seconds histograms are durations; ratio histograms
+        # (e.g. serving_batch_occupancy) print their raw values
+        in_ms = fam.name.endswith("_seconds")
+
+        def _fmt(v):
+            return f"{v * 1e3:.2f}ms" if in_ms else f"{v:.4g}"
+
+        for labels, child in fam.children():
+            snap = child.snapshot()
+            if not snap["count"]:
+                continue
+            lbl = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            print(f"  {fam.name}{{{lbl}}}: n={snap['count']} "
+                  f"p50={_fmt(snap['p50'])} p99={_fmt(snap['p99'])} "
+                  f"max={_fmt(snap['max'])}")
+
     recompiles = monitor.recompile_count()
     gate_ok = suite_ok and recompiles <= args.recompile_threshold
     check = {"recompile_threshold": args.recompile_threshold,
