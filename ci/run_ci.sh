@@ -102,6 +102,14 @@ echo "   run provably hangs)"
 python tools/chaos_check.py --check --multichip \
   --json "${CI_ARTIFACT_DIR:-.}/ci_chaos_dist_report.json"
 
+echo "== chaos elastic gate (resilience.elastic: injected device loss at dp=8"
+echo "   must auto-rescale to dp=4, resume from the last verified serial with"
+echo "   an exact batch trace and a digest equal to an uninterrupted dp=4"
+echo "   baseline; FLAGS_elastic=0 must die typed, retry must never absorb a"
+echo "   DeviceLostError, and a capacity return upscales 4->8)"
+python tools/chaos_check.py --check --elastic \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_chaos_elastic_report.json"
+
 echo "== unit tests (CPU, 8 virtual devices; FLAGS_check_program on via conftest)"
 python -m pytest tests/ -q -x
 

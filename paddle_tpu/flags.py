@@ -58,9 +58,30 @@ _DEFS: Dict[str, tuple] = {
                    "deterministic fault-injection schedule, e.g. "
                    "'compile:2:RuntimeError,ckpt_write:1:kill' "
                    "(paddle_tpu.resilience.faults; sites: compile, "
-                   "device_put, step, ckpt_write, shard_write, hang; "
-                   "actions add 'hang' — an interruptible stall the step "
-                   "watchdog must break). Empty disables"),
+                   "device_put, step, ckpt_write, shard_write, hang, "
+                   "device_lost; actions add 'hang' — an interruptible "
+                   "stall the step watchdog must break). Empty disables"),
+    "elastic": (bool, True,
+                "elastic preemption-tolerant training "
+                "(resilience.elastic): a typed DeviceLostError in a "
+                "parallel contrib.Trainer run with a checkpoint config "
+                "tears down the failed CompiledProgram, re-forms the "
+                "mesh on the surviving devices, restores from the last "
+                "verified checkpoint and fast-forwards the data cursor. "
+                "Off: the DeviceLostError propagates (die typed). "
+                "docs/RESILIENCE.md"),
+    "elastic_max_rescales": (int, 8,
+                             "elastic rescales allowed per Trainer.train "
+                             "call before escalating with PT612 — "
+                             "repeated device loss is an outage, not "
+                             "churn"),
+    "elastic_upscale_after_steps": (int, 0,
+                                    "after this many consecutive healthy "
+                                    "steps at reduced capacity, probe the "
+                                    "device set and rescale BACK UP when "
+                                    "capacity returned (no state restore "
+                                    "— the live state re-shards onto the "
+                                    "bigger mesh). 0 disables (default)"),
     "step_timeout_s": (float, 0.0,
                        "step watchdog (resilience.distributed): arm a "
                        "deadline around compile/step/collective sections; "

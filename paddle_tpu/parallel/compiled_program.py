@@ -30,6 +30,7 @@ from ..executor import _feed_host_bytes, _live_bytes, _shape_dtype_sig
 from ..lowering import LowerCtx, lower_block
 from ..profiler import RecordEvent
 from ..resilience import distributed as _dist
+from ..resilience import elastic as _elastic
 from ..resilience import faults as _faults
 from ..resilience import nonfinite as _nonfinite
 from ..resilience.retry import call_with_retry
@@ -128,6 +129,21 @@ class CompiledProgram:
         self._mesh = data_parallel_mesh(places)
         return self
 
+    def rescale(self, places) -> "CompiledProgram":
+        """Elastic recovery (resilience.elastic): tear down every compiled
+        step — the executables were built with shardings over the OLD
+        mesh and must never dispatch onto the new one — and re-form the
+        mesh on ``places`` (a device list or a ready Mesh). State in the
+        scope re-shards lazily: the next dispatch's ``in_shardings``
+        place it onto the new mesh (the same mechanism the PR 6 elastic
+        restore relies on). The replica-divergence sweep counter resets
+        with the mesh so the first post-rescale interval is a full one."""
+        with self._cache_lock:
+            self._cache.clear()
+            self._mesh = data_parallel_mesh(places)
+            self._replica_steps = 0
+        return self
+
     # -- execution (called by Executor.run) ------------------------------
     def _run(self, exe, feed, fetch_list, scope, return_numpy):
         from ..executor import global_scope
@@ -142,8 +158,14 @@ class CompiledProgram:
         program = exe._maybe_auto_remat(self._program, feed, fetch_names)
         mrec = _monitor.step_begin("parallel", program)
         try:
-            return self._run_body(exe, program, feed, fetch_names, scope,
-                                  return_numpy, mrec)
+            # classification wraps the WHOLE dispatch, not just the jit
+            # call: with async dispatch (watchdog unarmed) a real device
+            # loss only surfaces when a result is read — at
+            # unpack_step_result or the return_numpy materialization —
+            # and must still come out typed (resilience.elastic)
+            with _elastic.device_loss_classification("parallel_step"):
+                return self._run_body(exe, program, feed, fetch_names,
+                                      scope, return_numpy, mrec)
         finally:
             # paired with step_begin even when the step raises
             _monitor.step_end(mrec)
@@ -217,9 +239,18 @@ class CompiledProgram:
         # the parallel dispatch IS the collective section: a stuck ICI
         # collective here used to hang CI forever; under
         # FLAGS_step_timeout_s the watchdog dumps + raises instead
+        # the classification at the _run boundary turns the jax/XLA
+        # error zoo anywhere in this dispatch into typed DeviceLostError
+        # (transient=False — retry never absorbs a dead chip) so
+        # contrib.Trainer's elastic recovery can act on it
         with RecordEvent("executor::parallel_step"), \
                 _dist.watchdog_section("parallel_step",
                                        program=program) as tok:
+            # device_lost probe (resilience.elastic): fires BEFORE the
+            # dispatch donates anything, like a preemption notice racing
+            # the step; the classifier treats injected and real losses
+            # identically
+            _faults.fault_point("device_lost")
             _faults.fault_point("hang")
             result = step.fn(feed_vals, donated_vals,
                              read(step.ro_names), key)

@@ -154,10 +154,14 @@ def pipeline(stage_fn: Callable, stacked_params, x, mesh: Mesh,
     from ..resilience.distributed import (block_until_ready_concrete,
                                           watchdog_section)
 
+    from ..resilience.elastic import device_loss_classification
+
+    # a dead pp-ring rank surfaces here as an untyped runtime error —
+    # the shared wrapper classifies it typed so the elastic path can act
     with watchdog_section("collective",
                           detail=f"pipeline over '{axis_name}' "
                                  f"({num_microbatches} microbatches)") \
-            as tok:
+            as tok, device_loss_classification("collective"):
         out = fn(stacked_params, x)
         if tok is not None:
             # async dispatch: arm through device completion (no-op when

@@ -210,9 +210,13 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "sp",
     from ..resilience.distributed import (block_until_ready_concrete,
                                           watchdog_section)
 
+    from ..resilience.elastic import device_loss_classification
+
+    # a dead ring rank surfaces here as an untyped runtime error — the
+    # shared wrapper classifies it typed so the elastic path can act
     with watchdog_section("collective",
                           detail=f"ring_attention over '{seq_axis}'") \
-            as tok:
+            as tok, device_loss_classification("collective"):
         out = fn(q, k, v)
         if tok is not None:
             # async dispatch: arm through device completion (no-op when

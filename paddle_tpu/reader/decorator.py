@@ -35,19 +35,49 @@ def map_readers(func, *readers):
     return reader
 
 
-def shuffle(reader, buf_size):
-    def shuffled():
+def shuffle(reader, buf_size, seed=None):
+    """Buffered shuffle. With ``seed`` the order is DETERMINISTIC per
+    epoch: epoch k of any run with the same seed shuffles identically
+    (a fresh ``random.Random`` derived from ``(seed, epoch)``), and the
+    returned reader carries ``state_dict()``/``set_state_dict()`` so the
+    checkpoint data cursor (resilience.elastic — meta ``data_cursor``)
+    can resume a preempted run on exactly the interrupted sample order.
+    Without ``seed`` the legacy process-global ``random.shuffle`` is
+    kept (non-resumable, order differs per run)."""
+    def _buffered_shuffle(do_shuffle):
         buf = []
         for e in reader():
             buf.append(e)
             if len(buf) >= buf_size:
-                random.shuffle(buf)
+                do_shuffle(buf)
                 yield from buf
                 buf = []
         if buf:
-            random.shuffle(buf)
+            do_shuffle(buf)
             yield from buf
 
+    if seed is None:
+        def shuffled():
+            return _buffered_shuffle(random.shuffle)
+
+        return shuffled
+
+    state = {"seed": int(seed), "epoch": 0}
+
+    def shuffled():
+        # int derivation, not a tuple seed (tuple seeding is deprecated
+        # and hash-salted — the whole point here is run-to-run stability)
+        rng = random.Random((state["seed"] << 32) ^ state["epoch"])
+        state["epoch"] += 1
+        return _buffered_shuffle(rng.shuffle)
+
+    # state["epoch"] is the index the NEXT reader() call plays; the
+    # trainer's cursor realigns it to the epoch being (re-)entered so an
+    # interrupted epoch re-shuffles identically on resume
+    shuffled.state_dict = lambda: dict(state)
+    shuffled.set_state_dict = lambda s: state.update(
+        {"seed": int(s.get("seed", state["seed"])),
+         "epoch": int(s.get("epoch", state["epoch"]))})
     return shuffled
 
 

@@ -32,6 +32,21 @@ io / executor / contrib.Trainer / monitor:
   ``FLAGS_replica_divergence_policy``), and the step watchdog
   (``FLAGS_step_timeout_s``) that turns hangs into diagnosed failures.
   CI proof: ``tools/chaos_check.py --multichip``.
+* :mod:`~paddle_tpu.resilience.elastic` — preemption-tolerant training:
+  the jax/XLA error zoo at the parallel-step/collective sites is
+  classified into a typed ``DeviceLostError`` (never retried), the mesh
+  re-forms on the surviving devices (PT610–PT614 refusal diagnostics
+  when the topology cannot satisfy the checkpoint's non-dp axes), state
+  restores from the last verified sharded serial, and the data cursor
+  (``meta.json: data_cursor``) fast-forwards the reader so a rescaled
+  resume consumes exactly the remaining batch sequence.
+  ``contrib.Trainer`` wires the loop under ``FLAGS_elastic``; CI proof:
+  ``tools/chaos_check.py --elastic``.
+* :mod:`~paddle_tpu.resilience.graceful` — SIGTERM/preemption-notice
+  shutdown: one process-wide event that handlers set, the Trainer and
+  ``serving.ServingEngine`` consume (finish the step / drain the queue,
+  write a final verified checkpoint, exit 0), and retry backoff sleeps
+  wake on.
 
 Failure model, flag reference and checkpoint format: docs/RESILIENCE.md.
 """
@@ -45,11 +60,18 @@ from .deadline import Deadline, DeadlineExceeded
 from .distributed import (ReplicaDivergenceError, WatchdogTimeout,
                           handle_divergence, replica_divergence_check,
                           set_divergence_recovery, watchdog_section)
+from .elastic import (ELASTIC_CODES, DataCursor, DeviceLostError,
+                      ElasticRescaleError, classify_device_error,
+                      grad_accum_steps, plan_rescale, survivor_devices)
 from .faults import (SITES, FaultPlan, InjectedFault, active_plan,
                      clear_plan, fault_plan_guard, fault_point, install_plan)
+from .graceful import (install_signal_handlers, on_shutdown,
+                       request_shutdown, shutdown_event,
+                       shutdown_requested, uninstall_signal_handlers)
 from .nonfinite import POLICIES
 from .retry import (RetryExhaustedError, RetryPolicy, call_with_retry,
-                    is_transient, policy_for, retrying)
+                    is_transient, policy_for, retrying,
+                    set_thread_stop_event)
 
 __all__ = [
     # checkpoint integrity
@@ -67,6 +89,15 @@ __all__ = [
     # request deadlines)
     "RetryPolicy", "RetryExhaustedError", "retrying", "call_with_retry",
     "is_transient", "policy_for", "Deadline", "DeadlineExceeded",
+    "set_thread_stop_event",
+    # elastic preemption tolerance (device loss -> mesh rescale -> resume)
+    "DeviceLostError", "ElasticRescaleError", "ELASTIC_CODES",
+    "classify_device_error", "plan_rescale", "grad_accum_steps",
+    "survivor_devices", "DataCursor",
+    # graceful (SIGTERM/preemption-notice) shutdown
+    "shutdown_event", "shutdown_requested", "request_shutdown",
+    "on_shutdown", "install_signal_handlers",
+    "uninstall_signal_handlers",
     # non-finite degradation
     "POLICIES",
 ]

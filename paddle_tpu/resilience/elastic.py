@@ -1,0 +1,367 @@
+"""Elastic preemption-tolerant training: device-loss detection + mesh
+rescale planning + deterministic data resume.
+
+Production TPU fleets lose chips: preemptions, host evictions and device
+resets are ROUTINE (PAPERS.md, arXiv 2004.13336 — once optimizer state is
+dp-sharded, surviving a device loss REQUIRES a re-shard path). PR 6
+shipped the hard half — sharded elastic checkpoints whose restore on a
+different dp width is proven byte-equal — but nothing detected a lost
+device or drove the resume: a preempted run died with an untyped jax
+error and a human restarted it. This module is the missing control loop,
+in four pieces (docs/RESILIENCE.md, "Elastic training"):
+
+1. **Device-loss detection** — :func:`classify_device_error` maps the
+   jax/XLA error zoo at the parallel-step and collective sites onto a
+   typed :class:`DeviceLostError` (``transient = False``: retry must
+   NEVER absorb a dead chip — backing off against a missing device only
+   delays the rescale). The ``device_lost`` fault site
+   (``resilience.faults``) injects one deterministically.
+2. **Mesh rescale** — :func:`plan_rescale` re-forms the axis layout on
+   the surviving device set: non-dp axes (pp/sp) are load-bearing and
+   kept intact, the dp axis absorbs the loss (dp=8 -> 4, and back up
+   when capacity returns). A surviving topology that cannot satisfy the
+   checkpoint's non-dp axes refuses with a PT61x
+   :class:`ElasticRescaleError` instead of wedging.
+3. **Global-batch preservation** — :func:`grad_accum_steps`: after a
+   rescale the driver keeps feeding the SAME global batch, so each
+   surviving replica's slice grows by ``old_dp / new_dp``. Because the
+   loss is a mean over the global batch, widening the per-replica slice
+   inside one fused step is arithmetically identical to running
+   ``old_dp/new_dp`` gradient-accumulation micro-steps and applying the
+   optimizer once — the loss trajectory is comparable (on-device:
+   bit-comparable) across topologies and the PR 6 divergence checker
+   stays meaningful.
+4. **Deterministic data resume** — :class:`DataCursor`: the data-
+   pipeline position (epoch, batch offset, reader/shuffle state) is
+   checkpointed in the manifest (``meta.json: data_cursor``) and the
+   reader is fast-forwarded on restore, so a rescaled resume consumes
+   exactly the not-yet-committed batch sequence — no re-trained and no
+   skipped data.
+
+``contrib.Trainer`` wires the loop (``FLAGS_elastic``, default on for
+parallel runs with a checkpoint config): a :class:`DeviceLostError` — or
+a watchdog-diagnosed hang on the parallel step, which on a dead device
+is the same event seen later — tears down the failed ``CompiledProgram``,
+re-forms the mesh on the survivors, restores from the last VERIFIED
+sharded serial via the PR 6 elastic-restore path, fast-forwards the data
+cursor, and keeps training. Every rescale increments
+``elastic_rescales_total{old,new,direction}`` and logs the serial it
+restored from — recovery is never silent. End-to-end proof:
+``tools/chaos_check.py --elastic``.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import re
+from typing import Dict, Optional, Sequence
+
+__all__ = ["DeviceLostError", "ElasticRescaleError", "ELASTIC_CODES",
+           "classify_device_error", "device_loss_classification",
+           "record_device_lost", "plan_rescale", "grad_accum_steps",
+           "format_axes", "DataCursor", "survivor_devices"]
+
+logger = logging.getLogger("paddle_tpu.resilience")
+
+# PT61x: elastic-rescale diagnostics (sibling band of the checkpoint
+# integrity PT60x codes in resilience/checkpoint.py; docs/RESILIENCE.md)
+ELASTIC_CODES = {
+    "PT610": "surviving devices cannot satisfy the mesh's non-dp axes "
+             "(pp/sp need more devices than survive; dp is the only "
+             "elastic axis)",
+    "PT611": "surviving data-parallel width would fall below the "
+             "configured minimum",
+    "PT612": "elastic rescale budget exhausted (FLAGS_elastic_max_"
+             "rescales) — repeated device loss is an outage, not churn",
+    "PT613": "global batch is not divisible by any feasible surviving "
+             "dp width — batch preservation is impossible on this "
+             "topology",
+    "PT614": "no verified checkpoint to restore after a device loss — "
+             "elastic recovery has nothing to resume from",
+}
+
+
+class DeviceLostError(RuntimeError):
+    """A device (or its host) is gone: preemption, reset, eviction.
+    ``transient = False`` — :func:`resilience.retry.is_transient` must
+    never classify a dead chip as infrastructure noise; backoff against
+    a missing device only delays the mesh rescale. Carries the ``site``
+    that observed the loss and (when the runtime could attribute it)
+    the surviving device list."""
+
+    transient = False
+
+    def __init__(self, detail: str, site: str = "parallel_step",
+                 survivors=None):
+        self.site = site
+        self.detail = detail
+        self.survivors = survivors
+        super().__init__(
+            f"[elastic] device lost at site '{site}': {detail} — a dead "
+            f"chip is never retried. With FLAGS_elastic=1 a parallel "
+            f"contrib.Trainer run rescales the mesh onto the survivors "
+            f"and resumes from the last verified checkpoint "
+            f"(docs/RESILIENCE.md).")
+
+
+class ElasticRescaleError(RuntimeError):
+    """The elastic path cannot recover — carries a stable PT61x ``code``
+    (see :data:`ELASTIC_CODES`) naming exactly why. ``transient =
+    False``: an unsatisfiable topology does not get better by retrying."""
+
+    transient = False
+
+    def __init__(self, code: str, detail: str):
+        self.code = code
+        self.detail = detail
+        super().__init__(f"[{code}] elastic rescale refused: {detail} — "
+                         f"{ELASTIC_CODES[code]}")
+
+
+# -- 1. device-loss detection ----------------------------------------------
+
+# the jax/XLA error zoo that means "a device/host is gone", curated from
+# PJRT/TPU-runtime failure strings. Matched case-insensitively against the
+# whole exception chain; deliberately specific — a generic RuntimeError
+# must stay transient-retryable, misclassifying a compile hiccup as a
+# dead chip would trigger a pointless rescale.
+_DEVICE_LOSS_PATTERNS = tuple(re.compile(p, re.IGNORECASE) for p in (
+    r"site 'device_lost'",                    # the injected fault marker
+    r"device\s+(?:\S+\s+)?(?:is\s+)?(?:lost|halted|rebooted|reset)",
+    r"(?:tpu|device|chip|core)\s+.*\b(?:unhealthy|unavailable|"
+    r"disappeared|removed)",
+    r"\bpreempt(?:ed|ion)\b",
+    r"slice\s+health|ici\s+.*\b(?:down|failure|timed?\s*out)",
+    r"failed\s+to\s+(?:connect\s+to|enumerate)\s+.*(?:device|worker|host)",
+    r"(?:socket\s+closed|connection\s+reset\s+by\s+peer)"
+    r".*(?:worker|host|coordinator)",
+    r"host\s+.*\b(?:evicted|terminated|unreachable)",
+    r"\bNCCL\b.*\b(?:unhandled|failure|error)",
+))
+
+
+def record_device_lost(site: str) -> None:
+    """One definition of the ``elastic_device_lost_total`` counter for
+    every detection site (classifier, watchdog escalation) — two literal
+    copies would drift apart and split the series."""
+    from .. import monitor as _monitor
+
+    if _monitor.enabled():
+        _monitor.counter(
+            "elastic_device_lost_total",
+            "device losses detected (classified from the jax/XLA error "
+            "zoo, injected, or escalated from a watchdog-diagnosed "
+            "parallel-step hang)").labels(site=site).inc()
+
+
+def _chain(exc: BaseException):
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        yield exc
+        exc = exc.__cause__ or exc.__context__
+
+
+def classify_device_error(exc: BaseException,
+                          site: str = "parallel_step"
+                          ) -> Optional[DeviceLostError]:
+    """Map an exception raised at a parallel-step/collective site onto a
+    typed :class:`DeviceLostError`, or ``None`` when it is NOT a device
+    loss (shape bugs, transient compile errors, nan trips keep their
+    existing recovery paths). Walks the ``__cause__``/``__context__``
+    chain so a wrapped XLA runtime error is still recognized; an
+    exception that is already a :class:`DeviceLostError` passes through
+    unchanged."""
+    for e in _chain(exc):
+        if isinstance(e, DeviceLostError):
+            return e
+    for e in _chain(exc):
+        # the type gate applies PER CHAIN ELEMENT, like the text match:
+        # an Exception-typed wrapper around an XlaRuntimeError must
+        # still classify, while a ValueError/TypeError anywhere stays a
+        # program bug whatever its message says
+        if not isinstance(e, (RuntimeError, OSError, ConnectionError)):
+            continue
+        text = f"{type(e).__name__}: {e}"
+        if any(p.search(text) for p in _DEVICE_LOSS_PATTERNS):
+            record_device_lost(site)
+            # survivor attribution comes from the element that MATCHED
+            # (the runtime's own error) — a wrapper rarely carries it
+            return DeviceLostError(
+                f"{type(exc).__name__}: {exc}", site=site,
+                survivors=(getattr(e, "survivors", None)
+                           or getattr(exc, "survivors", None)))
+    return None
+
+
+@contextlib.contextmanager
+def device_loss_classification(site: str):
+    """Shared dispatch-site wrapper: run the body and re-raise anything
+    that classifies as a device loss as the typed
+    :class:`DeviceLostError` (chained), leaving every other exception on
+    its existing path. One implementation for the parallel-step and
+    collective sites, the way ``watchdog_section`` is shared."""
+    try:
+        yield
+    except Exception as e:
+        lost = classify_device_error(e, site=site)
+        if lost is not None and lost is not e:
+            raise lost from e
+        raise
+
+
+# -- 2. mesh rescale planning ----------------------------------------------
+
+def format_axes(axes: Dict[str, int]) -> str:
+    """``{'dp': 8, 'pp': 2}`` -> ``"dp=8,pp=2"`` (metric-label form)."""
+    return ",".join(f"{k}={v}" for k, v in axes.items()) or "dp=1"
+
+
+def plan_rescale(old_axes: Dict[str, int], n_devices: int, *,
+                 dp_axis: str = "dp", min_dp: int = 1,
+                 global_batch: Optional[int] = None) -> Dict[str, int]:
+    """Axis sizes for the survivor mesh: every non-dp axis (pp stages, sp
+    ring) keeps its size — those axes carry state layout the checkpoint
+    depends on — and the dp axis absorbs the loss (or the recovery, when
+    ``n_devices`` grew back). Refuses with a typed PT61x
+    :class:`ElasticRescaleError` when the surviving topology cannot
+    satisfy the non-dp axes (PT610), the dp width would fall below
+    ``min_dp`` (PT611), or no feasible dp width divides ``global_batch``
+    (PT613 — batch preservation impossible)."""
+    old_axes = {str(k): int(v) for k, v in old_axes.items()} or \
+        {dp_axis: 1}
+    if dp_axis not in old_axes:
+        old_axes = {dp_axis: 1, **old_axes}
+    non_dp = 1
+    for k, v in old_axes.items():
+        if k != dp_axis:
+            non_dp *= max(1, v)
+    if n_devices < non_dp:
+        raise ElasticRescaleError(
+            "PT610",
+            f"mesh axes {format_axes(old_axes)} need {non_dp} device(s) "
+            f"for the non-{dp_axis} axes alone, but only {n_devices} "
+            f"survive")
+    dp = n_devices // non_dp
+    if dp < max(1, min_dp):
+        raise ElasticRescaleError(
+            "PT611",
+            f"{n_devices} surviving device(s) over non-{dp_axis} axes "
+            f"of {non_dp} leave {dp_axis}={dp} < min {min_dp}")
+    if global_batch is not None:
+        capacity_dp = dp
+        while dp > max(1, min_dp) and int(global_batch) % dp:
+            dp -= 1
+        if int(global_batch) % dp:
+            raise ElasticRescaleError(
+                "PT613",
+                f"global batch {global_batch} is not divisible by any "
+                f"feasible {dp_axis} width <= {n_devices // non_dp} "
+                f"(min {min_dp})")
+        if dp < capacity_dp:
+            # at min_dp=1 a divisor always exists, so the refusal above
+            # is only reachable under an explicit floor — but giving up
+            # width to divisibility must never be silent: the surplus
+            # devices idle until the batch (or min_dp) changes
+            logger.warning(
+                "elastic: global batch %s is not divisible by %s=%d — "
+                "rescaling to %s=%d and leaving %d device(s) idle; set "
+                "min_dp (PT613 refusal) or pick a divisible global "
+                "batch to reclaim them", global_batch, dp_axis,
+                capacity_dp, dp_axis, dp, (capacity_dp - dp) * non_dp)
+    new_axes = dict(old_axes)
+    new_axes[dp_axis] = dp
+    return new_axes
+
+
+# -- 3. global-batch preservation ------------------------------------------
+
+def grad_accum_steps(old_dp: int, new_dp: int) -> int:
+    """Per-replica gradient-accumulation factor that keeps the effective
+    global batch after a rescale: each surviving replica processes
+    ``ceil(old_dp / new_dp)`` times its previous share inside the fused
+    step. Gradients of a mean loss are linear in the batch, so widening
+    the per-replica slice is exactly accumulating that many micro-grads
+    before one optimizer application."""
+    old_dp, new_dp = max(1, int(old_dp)), max(1, int(new_dp))
+    return max(1, -(-old_dp // new_dp))
+
+
+# -- 4. deterministic data resume ------------------------------------------
+
+class DataCursor:
+    """The data-pipeline position checkpointed with the model state
+    (``meta.json: data_cursor``): epoch index, batches already COMMITTED
+    this epoch (consumed by a step whose effect the checkpoint contains),
+    and the reader's own resume state (e.g. a seeded shuffle's
+    ``state_dict`` — ``reader.shuffle(..., seed=N)``). On restore the
+    trainer fast-forwards the reader past ``batch`` batches of epoch
+    ``epoch``, so the resumed run sees exactly the not-yet-committed
+    batch sequence: batches consumed after the checkpoint but before the
+    crash were rolled back with the state and are re-consumed — each
+    batch affects the committed lineage exactly once."""
+
+    def __init__(self, epoch: int = 0, batch: int = 0,
+                 reader_state: Optional[dict] = None):
+        self.epoch = int(epoch)
+        self.batch = int(batch)
+        self.reader_state = dict(reader_state) if reader_state else None
+
+    def to_dict(self) -> dict:
+        d = {"epoch": self.epoch, "batch": self.batch}
+        if self.reader_state is not None:
+            d["reader_state"] = self.reader_state
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> Optional["DataCursor"]:
+        if not isinstance(d, dict):
+            return None
+        return cls(epoch=d.get("epoch", 0), batch=d.get("batch", 0),
+                   reader_state=d.get("reader_state"))
+
+    def apply_to_reader(self, reader) -> None:
+        """Hand the reader its persisted resume state (a no-op for plain
+        generator functions — their determinism is positional and the
+        trainer's batch skip covers it). A persisted ``epoch`` field is
+        realigned to THIS cursor's epoch: the state was captured after
+        the reader advanced past the epoch being re-entered, and the
+        next ``reader()`` call must replay exactly that epoch's order."""
+        if self.reader_state is not None \
+                and hasattr(reader, "set_state_dict"):
+            state = dict(self.reader_state)
+            if "epoch" in state:
+                state["epoch"] = self.epoch
+            reader.set_state_dict(state)
+
+    @staticmethod
+    def capture(epoch: int, batch: int, reader=None) -> "DataCursor":
+        state = None
+        if reader is not None and hasattr(reader, "state_dict"):
+            try:
+                state = dict(reader.state_dict())
+            except Exception:
+                logger.exception(
+                    "elastic: reader.state_dict() failed; the cursor "
+                    "falls back to positional epoch/batch resume")
+        return DataCursor(epoch=epoch, batch=batch, reader_state=state)
+
+    def __repr__(self):
+        return (f"DataCursor(epoch={self.epoch}, batch={self.batch}"
+                f"{', reader_state=…' if self.reader_state else ''})")
+
+
+def survivor_devices(devices: Sequence, axes: Dict[str, int]):
+    """The device prefix a rescaled mesh uses: ``prod(axes)`` devices in
+    enumeration order (stable across the runs of one incarnation — the
+    PR 6 restore re-shards state onto whatever mesh exists, so the
+    choice only has to be deterministic, not minimal-movement)."""
+    n = 1
+    for v in axes.values():
+        n *= max(1, int(v))
+    devices = list(devices)
+    if len(devices) < n:
+        raise ElasticRescaleError(
+            "PT610", f"need {n} device(s) for {format_axes(axes)}, have "
+                     f"{len(devices)}")
+    return devices[:n]

@@ -18,6 +18,11 @@ site           probed where
                CompiledProgram feed packing)
 ``step``       immediately before a compiled step executes (run /
                run_chained / CompiledProgram)
+``device_lost`` inside the parallel-step dispatch (CompiledProgram), where
+               a real preempted/reset chip surfaces — the injected error
+               is classified into a typed ``DeviceLostError``
+               (``resilience.elastic``) exactly like the real zoo, so the
+               elastic rescale path is testable deterministically
 ``ckpt_write`` inside ``io.save_checkpoint`` after the blobs are written but
                BEFORE the manifest/rename — a ``kill`` here leaves a torn
                temp dir, never a torn live checkpoint
@@ -75,7 +80,7 @@ __all__ = ["FaultPlan", "InjectedFault", "fault_point", "install_plan",
 logger = logging.getLogger("paddle_tpu.resilience")
 
 SITES = ("compile", "device_put", "step", "ckpt_write", "shard_write",
-         "hang", "enqueue", "batch_dispatch", "overload")
+         "hang", "enqueue", "batch_dispatch", "overload", "device_lost")
 
 # injected exceptions carry this mixin so retry/give-up handlers can tell a
 # scripted fault from a real infrastructure error (real errors keep their

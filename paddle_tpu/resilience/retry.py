@@ -23,13 +23,15 @@ from __future__ import annotations
 import dataclasses
 import logging
 import random
+import threading
 import time
 from typing import Callable, Optional
 
 from .deadline import Deadline
 
 __all__ = ["RetryPolicy", "RetryExhaustedError", "call_with_retry",
-           "retrying", "is_transient", "policy_for"]
+           "retrying", "is_transient", "policy_for",
+           "set_thread_stop_event"]
 
 logger = logging.getLogger("paddle_tpu.resilience")
 
@@ -44,8 +46,9 @@ _PERMANENT = (TypeError, ValueError, KeyError, IndexError, AttributeError,
 
 def is_transient(exc: BaseException) -> bool:
     # classes can opt out of retry explicitly (WatchdogTimeout,
-    # ReplicaDivergenceError: RuntimeErrors by type, but retrying a hang
-    # or a determinism bug only delays the diagnosis)
+    # ReplicaDivergenceError, DeviceLostError: RuntimeErrors by type,
+    # but retrying a hang, a determinism bug or a DEAD CHIP only delays
+    # the diagnosis/rescale)
     if getattr(exc, "transient", None) is False:
         return False
     if not isinstance(exc, _TRANSIENT) or isinstance(exc, _PERMANENT):
@@ -77,6 +80,45 @@ class RetryPolicy:
         d = min(self.max_delay,
                 self.base_delay * self.multiplier ** (attempt - 1))
         return d * (1.0 + self.jitter * rng.random())
+
+
+# backoff sleeps are INTERRUPTIBLE: they wake when the process-wide
+# graceful-shutdown event (resilience.graceful) or a stop event the
+# calling thread registered (the serving dispatch thread registers its
+# engine's) fires — a shutdown or engine.stop() must never sit behind a
+# multi-second backoff in progress.
+_local = threading.local()
+
+
+def set_thread_stop_event(event: Optional[threading.Event]) -> None:
+    """Bind ``event`` to the CALLING thread: any backoff sleep this
+    thread enters wakes (and aborts the retry, typed) when it fires.
+    Pass ``None`` to unbind."""
+    _local.stop_event = event
+
+
+def _wait_backoff(delay: float) -> Optional[str]:
+    """Sleep ``delay`` seconds; returns the interruption reason
+    (``"shutdown"``/``"stop"``) when a stop event fired early, else
+    ``None`` after the full sleep."""
+    from .graceful import shutdown_event
+
+    events = [("shutdown", shutdown_event())]
+    thread_ev = getattr(_local, "stop_event", None)
+    if thread_ev is not None:
+        events.append(("stop", thread_ev))
+    deadline = time.monotonic() + delay
+    while True:
+        for name, ev in events:
+            if ev.is_set():
+                return name
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return None
+        # with one event a plain wait() suffices; with two, short slices
+        # keep both responsive (50 ms is noise against backoff scales)
+        events[0][1].wait(remaining if len(events) == 1
+                          else min(remaining, 0.05))
 
 
 class RetryExhaustedError(RuntimeError):
@@ -163,7 +205,25 @@ def call_with_retry(site: str, fn: Callable, *args,
                 "retrying in %.3fs: %s", type(e).__name__, site, attempt,
                 pol.max_attempts, d, e)
             if d > 0:
-                time.sleep(d)
+                interrupted = _wait_backoff(d)
+                if interrupted is not None:
+                    # a graceful shutdown / engine stop fired mid-backoff:
+                    # abort the retry loop typed instead of finishing the
+                    # sleep — the caller's teardown is waiting on us.
+                    # Counted apart from giveups: 'budget exhausted' and
+                    # 'teardown requested' must stay distinguishable
+                    if _monitor.enabled():
+                        _monitor.counter(
+                            "resilience_retry_aborts_total",
+                            "retry loops aborted mid-backoff by a "
+                            "shutdown/stop event (not a budget "
+                            "exhaustion)").labels(
+                            site=site, reason=interrupted).inc()
+                    logger.warning(
+                        "resilience: backoff at site '%s' interrupted by "
+                        "%s after attempt %d — aborting retries", site,
+                        interrupted, attempt)
+                    raise RetryExhaustedError(site, attempt, e) from e
 
 
 def retrying(site: str, policy: Optional[RetryPolicy] = None):

@@ -54,7 +54,7 @@ import itertools
 import logging
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -269,6 +269,13 @@ class ServingEngine:
         self._stopped = False
         self._drain = True
         self._thread: Optional[threading.Thread] = None
+        # wakes the dispatch thread's retry-backoff sleeps at stop():
+        # a multi-second compile backoff must never block teardown
+        # (resilience.retry.set_thread_stop_event)
+        self._stop_ev = threading.Event()
+        # graceful-preemption wiring (install_preemption_handler)
+        self._preempt_unregister: Optional[Callable[[], None]] = None
+        self._preempt_signals_held = False
 
         # degradation state (guarded by _lock)
         self._degraded = False
@@ -311,17 +318,66 @@ class ServingEngine:
         """Stop serving. ``drain=True`` lets the dispatcher finish every
         queued request first; ``drain=False`` fails queued requests with
         typed :class:`EngineStopped`. Either way each queued request
-        still reaches exactly one terminal outcome."""
+        still reaches exactly one terminal outcome. A retry backoff in
+        progress on the dispatch thread is woken immediately (its batch
+        fails typed) — stop() never waits out an exponential backoff."""
         with self._lock:
             self._running = False
             self._stopped = True
             self._drain = drain
             self._work.notify_all()
+        self._stop_ev.set()
+        # take-and-clear under the lock: a preemption callback thread and
+        # the owner's stop() can race here, and a double release would
+        # decrement the shared signal-handler refcount twice (tearing
+        # down another owner's graceful route)
+        with self._lock:
+            unregister, self._preempt_unregister = \
+                self._preempt_unregister, None
+            held, self._preempt_signals_held = \
+                self._preempt_signals_held, False
+        if unregister is not None:
+            unregister()
+        if held:
+            # release this engine's refcounted hold on the SIGTERM
+            # handler (another owner's hold keeps it installed; from a
+            # non-main thread the restore is a no-op and the harmless
+            # event-setting handler simply stays)
+            from ..resilience import graceful as _graceful
+
+            _graceful.uninstall_signal_handlers()
         if self._thread is not None:
             self._thread.join(timeout)
             if self._thread.is_alive():
                 logger.error("serving: dispatch thread did not exit within "
                              "%gs at stop()", timeout)
+
+    def install_preemption_handler(self) -> bool:
+        """Graceful preemption (resilience.graceful): route SIGTERM into
+        a drain-stop of this engine — admission closes, every queued
+        request still reaches its typed terminal outcome, ``ready()``
+        flips false so the balancer routes away, and the process can
+        exit 0. Returns whether a signal handler could be installed
+        (main thread only); the shutdown-event registration happens
+        either way, so an externally-raised ``request_shutdown()``
+        drains the engine too."""
+        from ..resilience import graceful as _graceful
+
+        # under the engine lock: stop() swaps these same fields from the
+        # preemption-callback thread, and an unlocked install racing it
+        # would leak a callback + signal-handler hold on a dead engine.
+        # (Lock order is engine -> graceful only; the late-registration
+        # path dispatches callbacks on a fresh thread, never inline.)
+        with self._lock:
+            if self._stopped:
+                return False
+            if self._preempt_unregister is None:
+                self._preempt_unregister = _graceful.on_shutdown(
+                    lambda: self.stop(drain=True))
+            if not self._preempt_signals_held:
+                self._preempt_signals_held = \
+                    _graceful.install_signal_handlers()
+            return self._preempt_signals_held
 
     def __enter__(self) -> "ServingEngine":
         return self.start()
@@ -486,6 +542,10 @@ class ServingEngine:
         guard) must NOT strand callers blocked on futures — every taken
         and queued request still gets a typed terminal outcome, and the
         engine stops admitting instead of queueing into a dead thread."""
+        from ..resilience.retry import set_thread_stop_event
+
+        # any retry backoff THIS thread enters wakes when stop() fires
+        set_thread_stop_event(self._stop_ev)
         try:
             self._dispatch_forever()
         except BaseException as e:

@@ -1,0 +1,856 @@
+"""resilience.elastic — elastic preemption-tolerant training: device-loss
+classification, mesh rescale planning (PT61x refusals), composed-mesh
+elastic restore, data-cursor resume, graceful SIGTERM shutdown, and the
+interruptible retry backoff. End-to-end proof lives in
+``tools/chaos_check.py --elastic``; these tests pin the pieces."""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.unique_name as un
+from paddle_tpu import monitor
+from paddle_tpu.resilience import elastic as E
+from paddle_tpu.resilience import faults, graceful
+from paddle_tpu.resilience.retry import (RetryExhaustedError, RetryPolicy,
+                                         call_with_retry,
+                                         set_thread_stop_event)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_elastic_env():
+    """Elastic tests flip flags, install fault plans and trip the global
+    graceful-shutdown event; restore everything so later tests see a
+    clean world."""
+    from paddle_tpu import flags as flags_mod
+
+    snap = dict(flags_mod._overrides)
+    yield
+    flags_mod._overrides.clear()
+    flags_mod._overrides.update(snap)
+    faults.clear_plan()
+    graceful.reset_shutdown_state()
+    set_thread_stop_event(None)
+
+
+# ---------------------------------------------------------------------------
+# 1. device-loss classification
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("msg", [
+    "TPU device 3 is halted",
+    "device was lost during execution",
+    "chip 2 became unhealthy",
+    "worker preempted by scheduler",
+    "ICI link down on slice 0",
+    "failed to connect to worker host-7",
+    "NCCL error: unhandled system error",
+])
+def test_classify_real_zoo(msg):
+    err = E.classify_device_error(RuntimeError(msg), site="parallel_step")
+    assert isinstance(err, E.DeviceLostError)
+    assert err.site == "parallel_step"
+    assert err.transient is False
+
+
+def test_classify_rejects_non_device_errors():
+    # a program bug whose message happens to say "device lost" is still a
+    # program bug (ValueError is never a device loss)
+    assert E.classify_device_error(ValueError("device lost")) is None
+    assert E.classify_device_error(RuntimeError("shape mismatch")) is None
+    assert E.classify_device_error(
+        RuntimeError("compile failed: invalid HLO")) is None
+
+
+def test_classify_walks_cause_chain():
+    inner = RuntimeError("device 5 halted unexpectedly")
+    try:
+        try:
+            raise inner
+        except RuntimeError as e:
+            raise RuntimeError("step dispatch failed") from e
+    except RuntimeError as outer:
+        got = E.classify_device_error(outer)
+    assert isinstance(got, E.DeviceLostError)
+
+
+def test_classify_gates_types_per_chain_element():
+    # an Exception-typed wrapper around a runtime device loss must still
+    # classify (the type gate applies per chain element) ...
+    try:
+        try:
+            raise RuntimeError("TPU chip 2 became unhealthy")
+        except RuntimeError as e:
+            raise Exception("dispatch wrapper") from e
+    except Exception as outer:
+        assert isinstance(E.classify_device_error(outer),
+                          E.DeviceLostError)
+    # ... while a chain with no runtime-ish element stays unclassified
+    # even when the text matches (a program bug quoting the zoo)
+    try:
+        try:
+            raise ValueError("device lost")
+        except ValueError as e:
+            raise Exception("wrapper") from e
+    except Exception as outer:
+        assert E.classify_device_error(outer) is None
+
+
+def test_device_loss_classification_context_manager():
+    with pytest.raises(E.DeviceLostError) as ei:
+        with E.device_loss_classification("collective"):
+            raise RuntimeError("ICI link down on slice 1")
+    assert ei.value.site == "collective"
+    # non-device errors pass through untouched
+    with pytest.raises(ValueError):
+        with E.device_loss_classification("collective"):
+            raise ValueError("bad shape")
+
+
+def test_classify_passes_existing_device_lost_through():
+    orig = E.DeviceLostError("chip gone", site="collective")
+    assert E.classify_device_error(orig) is orig
+
+
+def test_injected_device_lost_site_classifies():
+    assert "device_lost" in faults.SITES
+    with faults.fault_plan_guard("device_lost:1:RuntimeError"):
+        with pytest.raises(RuntimeError) as ei:
+            faults.fault_point("device_lost")
+    got = E.classify_device_error(ei.value)
+    assert isinstance(got, E.DeviceLostError)
+
+
+def test_retry_never_absorbs_device_loss():
+    """The negative control the acceptance criteria demand: a dead chip
+    must surface immediately — exactly one attempt, no backoff, no
+    RetryExhaustedError wrapper."""
+    attempts = {"n": 0}
+
+    def dead_chip():
+        attempts["n"] += 1
+        raise E.DeviceLostError("chip gone")
+
+    with pytest.raises(E.DeviceLostError):
+        call_with_retry("step", dead_chip)
+    assert attempts["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 2. rescale planning (PT61x refusals)
+# ---------------------------------------------------------------------------
+
+def test_plan_rescale_pure_dp():
+    assert E.plan_rescale({"dp": 8}, 4) == {"dp": 4}
+    assert E.plan_rescale({"dp": 4}, 8) == {"dp": 8}   # capacity returned
+    assert E.plan_rescale({"dp": 8}, 7) == {"dp": 7}
+
+
+def test_plan_rescale_composed_mesh_keeps_non_dp_axes():
+    assert E.plan_rescale({"dp": 4, "pp": 2}, 6) == {"dp": 3, "pp": 2}
+    assert E.plan_rescale({"dp": 2, "pp": 2, "sp": 2}, 4) == \
+        {"dp": 1, "pp": 2, "sp": 2}
+
+
+def test_plan_rescale_refuses_unsatisfiable_non_dp_axes():
+    with pytest.raises(E.ElasticRescaleError) as ei:
+        E.plan_rescale({"dp": 4, "pp": 4}, 3)
+    assert ei.value.code == "PT610"
+    assert ei.value.transient is False
+
+
+def test_plan_rescale_refuses_below_min_dp():
+    with pytest.raises(E.ElasticRescaleError) as ei:
+        E.plan_rescale({"dp": 8}, 1, min_dp=2)
+    assert ei.value.code == "PT611"
+
+
+def test_plan_rescale_global_batch_constraint():
+    # 6 survivors but batch 16: dp=6 does not divide 16 -> fall to 4
+    assert E.plan_rescale({"dp": 8}, 6, global_batch=16) == {"dp": 4}
+    with pytest.raises(E.ElasticRescaleError) as ei:
+        E.plan_rescale({"dp": 8}, 6, min_dp=5, global_batch=16)
+    assert ei.value.code == "PT613"
+
+
+def test_grad_accum_preserves_global_batch():
+    assert E.grad_accum_steps(8, 4) == 2
+    assert E.grad_accum_steps(8, 8) == 1
+    assert E.grad_accum_steps(8, 3) == 3   # ceil
+    assert E.grad_accum_steps(4, 8) == 1   # upscale never accumulates
+
+
+def test_elastic_codes_documented():
+    for code in ("PT610", "PT611", "PT612", "PT613", "PT614"):
+        assert code in E.ELASTIC_CODES
+    err = E.ElasticRescaleError("PT612", "budget spent")
+    assert "PT612" in str(err) and err.code == "PT612"
+
+
+def test_survivor_devices_prefix_and_refusal():
+    devs = list(range(8))
+    assert E.survivor_devices(devs, {"dp": 4}) == [0, 1, 2, 3]
+    with pytest.raises(E.ElasticRescaleError) as ei:
+        E.survivor_devices(devs[:3], {"dp": 2, "pp": 2})
+    assert ei.value.code == "PT610"
+
+
+# ---------------------------------------------------------------------------
+# 3. composed-mesh elastic restore + post-rescale divergence sweep
+# ---------------------------------------------------------------------------
+
+class _VarStub:
+    def __init__(self, name, shape):
+        self.name = name
+        self.shape = shape
+
+
+def _composed_state(mesh):
+    """State sharded over dp on a dp x pp mesh + a replicated var."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = np.random.RandomState(0)
+    sharded = rng.rand(8, 6).astype(np.float32)
+    repl = rng.rand(5, 3).astype(np.float32)
+    vals = {
+        "moment": jax.device_put(sharded, NamedSharding(mesh, P("dp"))),
+        "weight": jax.device_put(repl, NamedSharding(mesh, P())),
+    }
+    return vals, {"moment": sharded, "weight": repl}
+
+
+def test_elastic_restore_across_composed_mesh(tmp_path):
+    """A checkpoint saved from a dp x pp mesh restores byte-equal into a
+    fresh scope (the full-gather-equivalent reassembly), and the PT610
+    refusal fires when the surviving devices cannot satisfy the
+    checkpoint's pp axis."""
+    import jax
+
+    from paddle_tpu.resilience import checkpoint as rck
+    from paddle_tpu.resilience import distributed as dist
+    from paddle_tpu.parallel.sharding import make_mesh
+
+    mesh = make_mesh({"dp": 4, "pp": 2})
+    vals, host = _composed_state(mesh)
+    scope = fluid.Scope()
+    for n, v in vals.items():
+        scope.set_var(n, v)
+    vars_ = [_VarStub("moment", (8, 6)), _VarStub("weight", (5, 3))]
+    d = str(tmp_path / "ck")
+    os.makedirs(d)
+    manifest = dist.save_sharded_vars(d, vars_, scope, mesh)
+    rck.finalize_manifest(d)
+    # the dp-sharded var went out as per-shard slices, the replicated one
+    # to common.npz; the manifest records the composed mesh
+    assert manifest["sharding"]["mesh"] == {"dp": 4, "pp": 2}
+    assert manifest["sharding"]["specs"]["moment"]["parts"] == 4
+    assert "weight" not in manifest["sharding"]["specs"]
+
+    # elastic restore on a DIFFERENT (smaller) world: byte-equal
+    manifest2 = rck.verify_checkpoint(d)
+    scope2 = fluid.Scope()
+    dist.load_sharded_vars(d, manifest2, vars_, scope2)
+    for n in ("moment", "weight"):
+        np.testing.assert_array_equal(np.asarray(scope2.find_var(n)),
+                                      host[n])
+
+    # refusal diagnostics: 3 survivors cannot satisfy pp=2 at all widths
+    with pytest.raises(E.ElasticRescaleError) as ei:
+        E.plan_rescale(manifest2["sharding"]["mesh"], 1)
+    assert ei.value.code == "PT610"
+    # 6 survivors can: dp shrinks, pp survives
+    assert E.plan_rescale(manifest2["sharding"]["mesh"], 6) == \
+        {"dp": 3, "pp": 2}
+
+    # divergence-check pass immediately after a rescale: replicated state
+    # on the post-rescale (smaller) mesh must compare clean
+    small = make_mesh({"dp": 2, "pp": 2})
+    vals2, _ = _composed_state(small)
+    assert dist.replica_divergence_check(small, vals2) == []
+    del jax
+
+
+def test_compiled_program_rescale_clears_cache():
+    import jax
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            pred = fluid.layers.fc(x, 2)
+        prog = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=None)
+    prog._cache[("sentinel",)] = object()
+    prog._replica_steps = 7
+    old_mesh = prog._mesh
+    prog.rescale(jax.devices()[:4])
+    assert prog._cache == {}
+    assert prog._replica_steps == 0
+    assert prog._mesh is not old_mesh
+    assert dict(prog._mesh.shape) == {"dp": 4}
+
+
+# ---------------------------------------------------------------------------
+# 4. end-to-end: Trainer self-heals through an injected device loss
+# ---------------------------------------------------------------------------
+
+def _train_func():
+    x = fluid.layers.data("x", shape=[6], dtype="float32")
+    y = fluid.layers.data("y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, 1)
+    return fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+
+
+def _reader(n_batches=8, rows=16):
+    def rd():
+        for i in range(n_batches):
+            rng = np.random.RandomState(50 + i)
+            x = rng.rand(rows, 6).astype(np.float32)
+            y = x.sum(axis=1, keepdims=True).astype(np.float32)
+            yield [(x[j], y[j]) for j in range(rows)]
+    return rd
+
+
+def test_trainer_elastic_recovery_end_to_end(tmp_path):
+    """dp=8 -> injected device loss -> automatic rescale to dp=4,
+    restore from the last verified serial, exact fast-forward, rescale
+    counter + event recorded, divergence sweep armed across the rescale
+    and silent."""
+    import jax
+
+    fluid.set_flags({
+        "FLAGS_fault_plan": "device_lost:@4:RuntimeError",
+        "FLAGS_replica_check_interval": "2",
+    })
+    ckpt = fluid.contrib.CheckpointConfig(str(tmp_path / "ck"),
+                                          max_num_checkpoints=0,
+                                          step_interval=2, sharded=True)
+    with un.guard():
+        trainer = fluid.contrib.Trainer(
+            _train_func, lambda: fluid.optimizer.SGD(0.1),
+            checkpoint_config=ckpt, parallel=True)
+    trainer.elastic_devices_fn = lambda: jax.devices()[:4]
+    trace = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.contrib.EndStepEvent):
+            trace.append(ev.step)
+
+    before = monitor.metric_value("elastic_rescales_total", default=0.0,
+                                  old="dp=8", new="dp=4",
+                                  direction="down")
+    trainer.train(num_epochs=1, event_handler=handler,
+                  reader=_reader(8), feed_order=["x", "y"])
+    # loss at dispatch 4 (step idx 3); last verified serial at step 2
+    assert len(trainer.elastic_events) == 1
+    ev = trainer.elastic_events[0]
+    assert ev["old"] == "dp=8" and ev["new"] == "dp=4"
+    assert ev["direction"] == "down" and ev["step"] == 2
+    assert ev["grad_accum_steps"] == 2
+    assert ev["serial"] is not None
+    # steps 0,1,2 ran, the loss preempted step 3 before it committed;
+    # resume fast-forwards to batch 2 and consumes exactly 2..7 — no
+    # duplicates, no gaps
+    assert trace == [0, 1, 2] + list(range(2, 8))
+    assert trainer._step == 8
+    after = monitor.metric_value("elastic_rescales_total", default=0.0,
+                                 old="dp=8", new="dp=4",
+                                 direction="down")
+    assert after == before + 1
+    assert dict(trainer._train_mesh.shape) == {"dp": 4}
+
+
+def test_trainer_recovers_untyped_async_device_loss(tmp_path):
+    """A device loss that surfaces as an UNTYPED runtime error at result
+    materialization (fully-async dispatch, watchdog unarmed) must still
+    classify and recover — the headline feature cannot depend on the
+    watchdog being armed or on the error being raised synchronously."""
+    import jax
+
+    ckpt = fluid.contrib.CheckpointConfig(str(tmp_path / "ck"),
+                                          max_num_checkpoints=0,
+                                          step_interval=2, sharded=True)
+    with un.guard():
+        trainer = fluid.contrib.Trainer(
+            _train_func, lambda: fluid.optimizer.SGD(0.1),
+            checkpoint_config=ckpt, parallel=True)
+    trainer.elastic_devices_fn = lambda: jax.devices()[:4]
+    real_run = trainer.exe.run
+    calls = {"n": 0}
+
+    def flaky_run(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 4:
+            # what jax surfaces when the loss is only seen at a late
+            # result read: an untyped runtime error, no probe involved
+            raise RuntimeError("TPU device 2 is halted")
+        return real_run(*a, **k)
+
+    trainer.exe.run = flaky_run
+    trace = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.contrib.EndStepEvent):
+            trace.append(ev.step)
+
+    trainer.train(num_epochs=1, event_handler=handler,
+                  reader=_reader(6), feed_order=["x", "y"])
+    assert len(trainer.elastic_events) == 1
+    assert trainer.elastic_events[0]["new"] == "dp=4"
+    # loss preempted step 3; checkpoint at step 2 -> resume 2..5 exact
+    assert trace == [0, 1, 2] + list(range(2, 6))
+
+
+def test_elastic_recover_legacy_checkpoint_continues_forward(tmp_path):
+    """A restored checkpoint WITHOUT a data_cursor (pre-elastic writer)
+    must not rewind the data stream to batch 0 — it keeps the historic
+    continue-forward semantics, like the divergence path."""
+    import jax
+
+    ckpt = fluid.contrib.CheckpointConfig(str(tmp_path / "ck"),
+                                          step_interval=1)
+    with un.guard():
+        trainer = fluid.contrib.Trainer(
+            _train_func, lambda: fluid.optimizer.SGD(0.1),
+            checkpoint_config=ckpt, parallel=True)
+        prog = fluid.CompiledProgram(trainer.main_program) \
+            .with_data_parallel(loss_name=trainer.loss.name)
+    trainer._full_dp = int(prog._mesh.shape.get("dp", 1))
+    trainer._full_ndev = int(prog._mesh.devices.size)
+    # a legacy checkpoint: meta has step but NO data_cursor
+    with fluid.scope_guard(trainer.scope):
+        fluid.io.save_checkpoint(trainer.exe,
+                                 str(tmp_path / "ck" / "checkpoint_0"),
+                                 trainer.main_program,
+                                 scope=trainer.scope, meta={"step": 5})
+    trainer._cursor = E.DataCursor(epoch=0, batch=5)   # pre-loss position
+    trainer.elastic_devices_fn = lambda: jax.devices()[:4]
+    trainer._elastic_recover(E.DeviceLostError("chip gone"), prog)
+    assert (trainer._resume_cursor.epoch,
+            trainer._resume_cursor.batch) == (0, 5)
+
+
+def test_graceful_shutdown_skips_duplicate_interval_save(tmp_path):
+    """SIGTERM landing on a step that just wrote its interval checkpoint
+    must not write a second byte-identical serial in the grace window."""
+    ckpt = fluid.contrib.CheckpointConfig(str(tmp_path / "ck"),
+                                          step_interval=2,
+                                          max_num_checkpoints=0)
+
+    def handler(ev):
+        if isinstance(ev, fluid.contrib.EndStepEvent) and ev.step == 1:
+            graceful.request_shutdown("test")   # _step == 2: interval hit
+
+    with un.guard():
+        t = fluid.contrib.Trainer(_train_func,
+                                  lambda: fluid.optimizer.SGD(0.1),
+                                  checkpoint_config=ckpt)
+        t.train(num_epochs=1, event_handler=handler,
+                reader=_reader(6, rows=8), feed_order=["x", "y"])
+    assert t.interrupted
+    from paddle_tpu import resilience
+
+    assert len(resilience.iter_serials(str(tmp_path / "ck"))) == 1
+
+
+def test_trainer_elastic_disabled_dies_typed(tmp_path):
+    fluid.set_flags({
+        "FLAGS_fault_plan": "device_lost:@2:RuntimeError",
+        "FLAGS_elastic": "0",
+    })
+    ckpt = fluid.contrib.CheckpointConfig(str(tmp_path / "ck"),
+                                          step_interval=2, sharded=True)
+    with un.guard():
+        trainer = fluid.contrib.Trainer(
+            _train_func, lambda: fluid.optimizer.SGD(0.1),
+            checkpoint_config=ckpt, parallel=True)
+    with pytest.raises(E.DeviceLostError):
+        trainer.train(num_epochs=1, event_handler=lambda ev: None,
+                      reader=_reader(4), feed_order=["x", "y"])
+
+
+def test_trainer_watchdog_hang_on_parallel_step_escalates(tmp_path):
+    """Composition with the PR 6 watchdog: a WatchdogTimeout whose
+    section is the parallel step enters the elastic path; any other
+    section re-raises untouched."""
+    from paddle_tpu.resilience.distributed import WatchdogTimeout
+
+    ckpt = fluid.contrib.CheckpointConfig(str(tmp_path / "ck"),
+                                          step_interval=1, sharded=True)
+    with un.guard():
+        trainer = fluid.contrib.Trainer(
+            _train_func, lambda: fluid.optimizer.SGD(0.1),
+            checkpoint_config=ckpt, parallel=True)
+        prog = fluid.CompiledProgram(trainer.main_program) \
+            .with_data_parallel(loss_name=trainer.loss.name)
+    trainer._full_dp = int(prog._mesh.shape.get("dp", 1))
+    trainer._full_ndev = int(prog._mesh.devices.size)
+    trainer._train_mesh = prog._mesh
+    import jax
+
+    trainer.elastic_devices_fn = lambda: jax.devices()[:4]
+    # nothing checkpointed yet -> PT614 escalation even for the right
+    # section (recovery is never silent: a typed refusal, not a wedge)
+    with pytest.raises(E.ElasticRescaleError) as ei:
+        trainer._elastic_recover(WatchdogTimeout("parallel_step", 1.0),
+                                 prog)
+    assert ei.value.code == "PT614"
+    # a compile-section hang is NOT a device loss: re-raised untouched
+    with pytest.raises(WatchdogTimeout):
+        trainer._elastic_recover(WatchdogTimeout("compile", 1.0), prog)
+    # with a verified checkpoint present the same escalation recovers
+    trainer._save_checkpoint()
+    prog2 = trainer._elastic_recover(
+        WatchdogTimeout("parallel_step", 1.0), prog)
+    assert dict(prog2._mesh.shape) == {"dp": 4}
+    assert trainer.elastic_events[-1]["cause"] == "WatchdogTimeout"
+
+
+def test_trainer_rescale_budget_escalates(tmp_path):
+    ckpt = fluid.contrib.CheckpointConfig(str(tmp_path / "ck"),
+                                          step_interval=1, sharded=True)
+    fluid.set_flags({"FLAGS_elastic_max_rescales": "1"})
+    with un.guard():
+        trainer = fluid.contrib.Trainer(
+            _train_func, lambda: fluid.optimizer.SGD(0.1),
+            checkpoint_config=ckpt, parallel=True)
+        prog = fluid.CompiledProgram(trainer.main_program) \
+            .with_data_parallel(loss_name=trainer.loss.name)
+    trainer._full_dp = int(prog._mesh.shape.get("dp", 1))
+    trainer._full_ndev = int(prog._mesh.devices.size)
+    trainer._save_checkpoint()
+    import jax
+
+    trainer.elastic_devices_fn = lambda: jax.devices()[:4]
+    trainer._elastic_recover(E.DeviceLostError("first"), prog)
+    with pytest.raises(E.ElasticRescaleError) as ei:
+        trainer._elastic_recover(E.DeviceLostError("second"), prog)
+    assert ei.value.code == "PT612"
+
+
+# ---------------------------------------------------------------------------
+# 5. deterministic data resume (cursor + seeded shuffle)
+# ---------------------------------------------------------------------------
+
+def test_data_cursor_roundtrip():
+    c = E.DataCursor(epoch=2, batch=7, reader_state={"seed": 5,
+                                                     "epoch": 3})
+    c2 = E.DataCursor.from_dict(c.to_dict())
+    assert (c2.epoch, c2.batch) == (2, 7)
+    assert c2.reader_state == {"seed": 5, "epoch": 3}
+    assert E.DataCursor.from_dict(None) is None
+    assert E.DataCursor.from_dict("junk") is None
+
+
+def test_seeded_shuffle_is_deterministic_per_epoch():
+    from paddle_tpu.reader import shuffle
+
+    base = lambda: iter(range(20))  # noqa: E731
+    a = shuffle(base, 8, seed=42)
+    b = shuffle(base, 8, seed=42)
+    ep0_a, ep1_a = list(a()), list(a())
+    ep0_b, ep1_b = list(b()), list(b())
+    assert ep0_a == ep0_b and ep1_a == ep1_b
+    assert ep0_a != ep1_a           # epochs differ from each other
+    assert sorted(ep0_a) == list(range(20))
+    # unseeded keeps the legacy reader (no resume state)
+    legacy = shuffle(base, 8)
+    assert not hasattr(legacy, "state_dict")
+
+
+def test_cursor_realigns_shuffle_epoch_on_resume():
+    """Mid-epoch capture: the reader has already advanced its epoch
+    counter past the epoch being re-entered; apply_to_reader realigns so
+    the resumed epoch replays the SAME order."""
+    from paddle_tpu.reader import shuffle
+
+    base = lambda: iter(range(12))  # noqa: E731
+    r = shuffle(base, 6, seed=9)
+    epoch1_order = (list(r()), list(r()))[1]   # play epochs 0 and 1
+    # crash "mid epoch 1": cursor captured after 3 batches of epoch 1
+    cur = E.DataCursor.capture(epoch=1, batch=3, reader=r)
+    # fresh process: new reader, state epoch starts at 0
+    r2 = shuffle(base, 6, seed=9)
+    cur2 = E.DataCursor.from_dict(cur.to_dict())
+    cur2.apply_to_reader(r2)
+    assert list(r2()) == epoch1_order   # epoch 1 replays identically
+
+
+def test_trainer_checkpoints_data_cursor(tmp_path):
+    ckpt = fluid.contrib.CheckpointConfig(str(tmp_path / "ck"),
+                                          step_interval=3)
+    with un.guard():
+        t1 = fluid.contrib.Trainer(_train_func,
+                                   lambda: fluid.optimizer.SGD(0.1),
+                                   checkpoint_config=ckpt)
+        t1.train(num_epochs=1, event_handler=lambda ev: None,
+                 reader=_reader(5, rows=8), feed_order=["x", "y"])
+    # end-of-epoch save: cursor points at the next epoch's first batch
+    with un.guard():
+        t2 = fluid.contrib.Trainer(_train_func,
+                                   lambda: fluid.optimizer.SGD(0.1),
+                                   checkpoint_config=ckpt)
+    assert t2._step == 5
+    assert t2._resume_cursor is not None
+    assert (t2._resume_cursor.epoch, t2._resume_cursor.batch) == (1, 0)
+
+
+def test_trainer_resume_fast_forwards_mid_epoch(tmp_path):
+    """Kill-after-checkpoint resume: the second incarnation consumes
+    exactly the batches after the cursor (positional fast-forward)."""
+    ckpt = fluid.contrib.CheckpointConfig(str(tmp_path / "ck"),
+                                          step_interval=2,
+                                          max_num_checkpoints=0)
+    consumed = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.contrib.EndStepEvent):
+            consumed.append((ev.epoch, ev.step))
+
+    class _Stop(Exception):
+        pass
+
+    def killing_handler(ev):
+        handler(ev)
+        if isinstance(ev, fluid.contrib.EndStepEvent) and ev.step == 2:
+            raise _Stop()   # die AFTER step 2 (checkpoint at step 2)
+
+    with un.guard():
+        t1 = fluid.contrib.Trainer(_train_func,
+                                   lambda: fluid.optimizer.SGD(0.1),
+                                   checkpoint_config=ckpt)
+        with pytest.raises(_Stop):
+            t1.train(num_epochs=1, event_handler=killing_handler,
+                     reader=_reader(6, rows=8), feed_order=["x", "y"])
+    consumed.clear()
+    with un.guard():
+        t2 = fluid.contrib.Trainer(_train_func,
+                                   lambda: fluid.optimizer.SGD(0.1),
+                                   checkpoint_config=ckpt)
+        t2.train(num_epochs=1, event_handler=handler,
+                 reader=_reader(6, rows=8), feed_order=["x", "y"])
+    # checkpoint was at step 2 (cursor batch=2): resume consumes 2..5
+    assert consumed == [(0, s) for s in range(2, 6)]
+
+
+# ---------------------------------------------------------------------------
+# 6. graceful shutdown (SIGTERM / preemption notice)
+# ---------------------------------------------------------------------------
+
+def test_trainer_graceful_shutdown_finishes_step_and_checkpoints(tmp_path):
+    """An in-process shutdown request (what the SIGTERM handler issues):
+    the in-flight step completes, a final checkpoint lands, train()
+    returns with .interrupted set."""
+    ckpt = fluid.contrib.CheckpointConfig(str(tmp_path / "ck"),
+                                          step_interval=100,
+                                          max_num_checkpoints=0)
+    steps = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.contrib.EndStepEvent):
+            steps.append(ev.step)
+            if ev.step == 1:
+                graceful.request_shutdown("test")
+
+    with un.guard():
+        t = fluid.contrib.Trainer(_train_func,
+                                  lambda: fluid.optimizer.SGD(0.1),
+                                  checkpoint_config=ckpt)
+        t.train(num_epochs=2, event_handler=handler,
+                reader=_reader(6, rows=8), feed_order=["x", "y"])
+    assert t.interrupted is True
+    assert steps == [0, 1]           # finished the in-flight step, no more
+    from paddle_tpu import resilience
+
+    serials = resilience.iter_serials(str(tmp_path / "ck"))
+    assert len(serials) == 1         # the final shutdown checkpoint
+    meta = fluid.io.load_checkpoint(t.exe, serials[0][1],
+                                    main_program=t.main_program,
+                                    scope=fluid.Scope())
+    assert meta["step"] == 2
+    assert meta["data_cursor"]["batch"] == 2
+
+
+_SIGTERM_SCRIPT = r"""
+import os, signal, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+import paddle_tpu as fluid
+
+def train_func():
+    x = fluid.layers.data("x", shape=[6], dtype="float32")
+    y = fluid.layers.data("y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, 1)
+    return fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+
+def reader():
+    for i in range(50):
+        rng = np.random.RandomState(i)
+        x = rng.rand(8, 6).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True).astype(np.float32)
+        yield [(x[j], y[j]) for j in range(8)]
+
+ckpt = fluid.contrib.CheckpointConfig({ckpt_dir!r}, step_interval=1000,
+                                      max_num_checkpoints=0)
+trainer = fluid.contrib.Trainer(train_func,
+                                lambda: fluid.optimizer.SGD(0.1),
+                                checkpoint_config=ckpt)
+
+def handler(ev):
+    if isinstance(ev, fluid.contrib.EndStepEvent) and ev.step == 2:
+        # the preemption notice arrives mid-training
+        os.kill(os.getpid(), signal.SIGTERM)
+
+trainer.train(num_epochs=1, event_handler=handler, reader=reader,
+              feed_order=["x", "y"])
+assert trainer.interrupted, "SIGTERM did not unwind train()"
+print("GRACEFUL_EXIT step=%d" % trainer._step)
+"""
+
+
+def test_trainer_sigterm_self_delivered_exits_zero(tmp_path):
+    """The satellite's end-to-end proof: a self-delivered SIGTERM makes
+    the process finish the in-flight step, write a final verified
+    checkpoint and exit 0."""
+    ckpt_dir = str(tmp_path / "ck")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         _SIGTERM_SCRIPT.format(repo=REPO, ckpt_dir=ckpt_dir)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "GRACEFUL_EXIT" in proc.stdout
+    from paddle_tpu import resilience
+
+    serials = resilience.iter_serials(ckpt_dir)
+    assert len(serials) == 1
+    # the final checkpoint VERIFIES (manifest complete, nothing torn)
+    resilience.verify_checkpoint(serials[0][1])
+
+
+def test_serving_engine_drains_on_shutdown_request():
+    """ServingEngine + install_preemption_handler: a shutdown request
+    drains the queue (every request reaches its terminal outcome) and
+    flips ready() false."""
+    from paddle_tpu import serving
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[5], dtype="float32")
+            pred = fluid.layers.fc(x, 3)
+        infer = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    eng = serving.ServingEngine(
+        infer, feed_names=["x"], fetch_list=[pred.name], scope=scope,
+        executor=exe, config=serving.ServingConfig(max_batch=4))
+    eng.start()
+    eng.install_preemption_handler()
+    futs = [eng.submit({"x": np.random.RandomState(i)
+                        .rand(1, 5).astype(np.float32)})
+            for i in range(6)]
+    graceful.request_shutdown("test")
+    # the drain-stop runs in a daemon thread; every future must settle
+    for f in futs:
+        r = f.result(timeout=30)
+        assert np.asarray(r[0]).shape == (1, 3)
+    deadline = time.monotonic() + 30
+    while eng.ready() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not eng.ready()
+    acct = eng.accounting()
+    assert acct["exact"] and acct["pending"] == 0
+    # this test dispatches full batches; clear the registry so absolute
+    # histogram assertions elsewhere (serving occupancy max) see a
+    # fresh window — the test_monitor.py idiom
+    monitor.reset()
+
+
+def test_retry_backoff_wakes_on_thread_stop_event():
+    """Satellite fix: a backoff in progress aborts (typed) when the
+    thread's stop event fires instead of sleeping out the delay."""
+    ev = threading.Event()
+    set_thread_stop_event(ev)
+    threading.Timer(0.15, ev.set).start()
+    pol = RetryPolicy(max_attempts=5, base_delay=30.0, max_delay=30.0,
+                      timeout=None)
+    t0 = time.monotonic()
+    with pytest.raises(RetryExhaustedError):
+        call_with_retry("compile",
+                        lambda: (_ for _ in ()).throw(
+                            RuntimeError("transient")), policy=pol)
+    assert time.monotonic() - t0 < 5.0   # not the 30s backoff
+
+
+def test_retry_backoff_wakes_on_global_shutdown():
+    threading.Timer(0.15, graceful.request_shutdown, args=("t",)).start()
+    pol = RetryPolicy(max_attempts=5, base_delay=30.0, max_delay=30.0,
+                      timeout=None)
+    t0 = time.monotonic()
+    with pytest.raises(RetryExhaustedError):
+        call_with_retry("compile",
+                        lambda: (_ for _ in ()).throw(
+                            RuntimeError("transient")), policy=pol)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_signal_handlers_are_refcounted():
+    """A scoped owner (Trainer.train) uninstalling must not tear down
+    another owner's (ServingEngine) preemption handler."""
+    import signal
+
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        assert graceful.install_signal_handlers()   # engine's hold
+        ours = signal.getsignal(signal.SIGTERM)
+        assert ours is not prev
+        assert graceful.install_signal_handlers()   # trainer's hold
+        graceful.uninstall_signal_handlers()        # trainer exits
+        assert signal.getsignal(signal.SIGTERM) is ours  # engine's stays
+        graceful.uninstall_signal_handlers()        # last owner exits
+        assert signal.getsignal(signal.SIGTERM) is prev
+    finally:
+        graceful.uninstall_signal_handlers()
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_divergence_restore_rewinds_data_cursor(tmp_path):
+    """_recover_from_checkpoint (the divergence-restore walk) must adopt
+    the checkpoint's data cursor so the step loop rewinds the data
+    stream with the state — the same exactly-once contract as the
+    elastic path."""
+    ckpt = fluid.contrib.CheckpointConfig(str(tmp_path / "ck"),
+                                          step_interval=2,
+                                          max_num_checkpoints=0)
+    with un.guard():
+        t = fluid.contrib.Trainer(_train_func,
+                                  lambda: fluid.optimizer.SGD(0.1),
+                                  checkpoint_config=ckpt)
+        t.train(num_epochs=1, event_handler=lambda ev: None,
+                reader=_reader(4, rows=8), feed_order=["x", "y"])
+    t._resume_cursor = None
+    assert t._recover_from_checkpoint()
+    assert t._restored_step == t._step
+    assert t._resume_cursor is not None
+    # newest serial is the end-of-epoch save: next batch = epoch 1/batch 0
+    assert (t._resume_cursor.epoch, t._resume_cursor.batch) == (1, 0)
+
+
+def test_graceful_on_shutdown_runs_late_registrations():
+    graceful.request_shutdown("early")
+    ran = threading.Event()
+    unregister = graceful.on_shutdown(ran.set)
+    assert ran.wait(5.0)   # registered after the fact: runs immediately
+    unregister()

@@ -50,6 +50,27 @@ Usage:
          must die as a diagnosed WatchdogTimeout within the deadline;
          negative control: the same hang with the watchdog DISABLED must
          still be hanging when the harness gives up waiting.
+  python tools/chaos_check.py --check --elastic --json ci_chaos_elastic_report.json
+      Elastic preemption-tolerance leg (resilience.elastic, contrib.
+      Trainer wiring, 8 virtual CPU devices, ZeRO Adam + sharded
+      checkpoints):
+      1. victim — a dp=8 run takes an injected ``device_lost`` fault
+         mid-run; it must AUTOMATICALLY rescale to dp=4 on the surviving
+         devices, restore from the last verified sharded serial,
+         fast-forward the data cursor and finish — consuming exactly the
+         remaining batch sequence (no duplicates, no gaps, proven by the
+         recorded batch-id trace), with the divergence sweep armed across
+         the rescale and silent.
+      2. baseline — an uninterrupted dp=4 run restored from a COPY of the
+         same serial and fed the same post-resume data must reach a
+         bit-identical final params digest.
+      3. negative control — the same fault with FLAGS_elastic=0 must die
+         with a typed DeviceLostError (no silent recovery).
+      4. retry control — call_with_retry over a DeviceLostError must
+         re-raise immediately (retry provably never absorbs a dead chip).
+      5. upscale — with FLAGS_elastic_upscale_after_steps set and
+         capacity returning, the run must rescale dp=4 -> dp=8 without a
+         restore and still consume the exact batch sequence.
 """
 from __future__ import annotations
 
@@ -257,6 +278,283 @@ def run_verify_worker(args) -> int:
     with open(args.result, "w") as f:
         json.dump(result, f, indent=1)
     return 0
+
+
+# ---------------------------------------------------------------------------
+# elastic worker: contrib.Trainer end-to-end (device loss -> rescale ->
+# deterministic resume). The Trainer IS the wired recovery path, so the
+# gate exercises exactly what production runs.
+# ---------------------------------------------------------------------------
+
+EL_STEPS = 12                 # batches in the single epoch
+EL_CKPT_EVERY = 4             # trainer step_interval -> serials at 4, 8, 12
+EL_KILL_HIT = 7               # device_lost on the 7th parallel dispatch
+EL_RESUME_STEP = 4            # last verified serial before the loss
+EL_ROWS = 16                  # global batch rows (divisible by dp=8 and 4)
+
+
+def _el_batch(step: int):
+    import numpy as np
+
+    rng = np.random.RandomState(7000 + step)
+    x = rng.rand(EL_ROWS, 16).astype(np.float32)
+    w = (np.arange(1, 17, dtype=np.float32).reshape(16, 1)) / 16.0
+    return x, (x @ w).astype(np.float32)
+
+
+def run_elastic_worker(args) -> int:
+    """One deterministic parallel Trainer run (dp = all visible devices,
+    ZeRO Adam, sharded checkpoints, data cursor on). ``EL_SURVIVORS``
+    (env, comma list) scripts what the device probe reports per call —
+    the CPU-sim stand-in for the runtime's post-loss enumeration."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+
+    def train_func():
+        x = fluid.layers.data("x", shape=[16], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, 16)
+        pred = fluid.layers.fc(h, 1)
+        return fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+
+    def reader():
+        for i in range(args.total_steps):
+            x, y = _el_batch(i)
+            yield [(x[j], y[j]) for j in range(x.shape[0])]
+
+    survivors = [int(s) for s in
+                 os.environ.get("EL_SURVIVORS", "").split(",") if s]
+    calls = {"n": 0}
+
+    def devices_fn():
+        k = survivors[min(calls["n"], len(survivors) - 1)]
+        calls["n"] += 1
+        return jax.devices()[:k]
+
+    bs = fluid.BuildStrategy()
+    bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+    ckpt = fluid.contrib.CheckpointConfig(
+        args.ckpt_dir, max_num_checkpoints=0,
+        step_interval=args.ckpt_every, sharded=True)
+    trainer = fluid.contrib.Trainer(
+        train_func, lambda: fluid.optimizer.Adam(learning_rate=0.01),
+        checkpoint_config=ckpt, parallel=True, build_strategy=bs)
+    if survivors:
+        trainer.elastic_devices_fn = devices_fn
+    start_step = trainer._step
+    # batch-id trace: EndStepEvent.step IS the batch index (one epoch,
+    # batches are f(step)); the third field counts rescales so far, so
+    # the gate can split the trace at the recovery point
+    trace = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.contrib.EndStepEvent):
+            trace.append([ev.epoch, ev.step,
+                          len(trainer.elastic_events)])
+
+    trainer.train(num_epochs=1, event_handler=handler, reader=reader,
+                  feed_order=["x", "y"])
+    result = {
+        "start_step": start_step,
+        "final_step": trainer._step,
+        "trace": trace,
+        "elastic_events": trainer.elastic_events,
+        "params_sha256": _digest_scope(trainer.scope),
+        "final_mesh": ({k: int(v) for k, v in
+                        dict(trainer._train_mesh.shape).items()}
+                       if trainer._train_mesh is not None else None),
+        "fastforward_batches": monitor.metric_value(
+            "elastic_data_fastforward_batches_total", default=0.0),
+        "n_devices": jax.device_count(),
+    }
+    with open(args.result, "w") as f:
+        json.dump(result, f, indent=1)
+    return 0
+
+
+def _spawn_el(ckpt_dir: str, result: str, extra_env: dict,
+              n_devices: int = 8, timeout=240):
+    """Spawn an elastic worker; returns (rc, elapsed_s, stderr_tail)."""
+    import time
+
+    env = dict(os.environ)
+    for leak in ("FLAGS_fault_plan", "FLAGS_fault_seed",
+                 "FLAGS_retry_max_attempts", "FLAGS_retry_timeout",
+                 "FLAGS_nan_inf_policy", "FLAGS_monitor",
+                 "FLAGS_step_timeout_s", "FLAGS_replica_check_interval",
+                 "FLAGS_watchdog_hard_exit", "FLAGS_elastic",
+                 "FLAGS_elastic_max_rescales",
+                 "FLAGS_elastic_upscale_after_steps", "EL_SURVIVORS",
+                 "XLA_FLAGS"):
+        env.pop(leak, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    env["FLAGS_retry_base_delay"] = "0.01"
+    env.update(extra_env)
+    cmd = [sys.executable, os.path.abspath(__file__), "--el-worker",
+           "--ckpt-dir", ckpt_dir, "--result", result,
+           "--total-steps", str(EL_STEPS),
+           "--ckpt-every", str(EL_CKPT_EVERY)]
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(cmd, env=env, cwd=REPO, timeout=timeout,
+                              stderr=subprocess.PIPE)
+        rc = proc.returncode
+        err = (proc.stderr or b"").decode(errors="replace")
+    except subprocess.TimeoutExpired as e:
+        rc = None
+        err = (e.stderr or b"").decode(errors="replace") if e.stderr else ""
+    return rc, time.monotonic() - t0, err[-65536:]
+
+
+def run_elastic_gate(args) -> int:
+    work = os.path.abspath(args.workdir)
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work, exist_ok=True)
+    checks = []
+    report = {"mode": "elastic", "phases": {}}
+
+    def check(name, ok, detail=""):
+        checks.append((name, bool(ok), detail))
+        print(f"  [{'ok' if ok else 'MISS'}] {name}"
+              + (f": {detail}" if detail else ""))
+
+    fault = f"device_lost:@{EL_KILL_HIT}:RuntimeError"
+    remaining = list(range(EL_RESUME_STEP, EL_STEPS))
+
+    def post_resume(res):
+        return [s for _e, s, k in res["trace"] if k >= 1] if res else None
+
+    # -- phase 1: victim — dp=8, injected device loss, must self-heal
+    print(f"== phase 1: dp=8 victim (FLAGS_fault_plan={fault}, survivors "
+          f"report 4 devices; divergence sweep armed across the rescale)")
+    victim_dir = os.path.join(work, "victim_ckpts")
+    rc, el1, err = _spawn_el(
+        victim_dir, os.path.join(work, "victim.json"),
+        {"FLAGS_fault_plan": fault, "EL_SURVIVORS": "4",
+         "FLAGS_replica_check_interval": "3"})
+    vic = _load(os.path.join(work, "victim.json"))
+    report["phases"]["victim"] = {"rc": rc, "result": vic,
+                                  "elapsed_s": el1}
+    check("victim_completed", rc == 0 and vic
+          and vic["final_step"] == EL_STEPS,
+          f"rc={rc}" + (f" stderr: …{err[-200:]}" if rc else ""))
+    ev = (vic or {}).get("elastic_events") or []
+    check("victim_rescaled_8_to_4",
+          len(ev) == 1 and ev[0]["old"] == "dp=8"
+          and ev[0]["new"] == "dp=4" and ev[0]["direction"] == "down",
+          f"events: {ev}")
+    check("restored_from_last_verified_serial",
+          ev and ev[0]["step"] == EL_RESUME_STEP
+          and ev[0]["serial"] is not None,
+          f"event: {ev[0] if ev else None}")
+    check("rescale_logged_with_serial",
+          "restored from checkpoint_" in err and "rescaled dp=8 -> dp=4"
+          in err, "recovery is never silent")
+    check("post_resume_batches_exact",
+          vic is not None and post_resume(vic) == remaining,
+          f"post-resume trace {post_resume(vic)} want {remaining} "
+          f"(no duplicates, no gaps)")
+    check("divergence_sweep_silent_across_rescale",
+          rc == 0 and "ReplicaDivergenceError" not in err)
+    check("final_mesh_is_dp4", vic and vic["final_mesh"] == {"dp": 4},
+          f"final mesh: {vic and vic['final_mesh']}")
+
+    # -- phase 2: uninterrupted dp=4 baseline from a COPY of the same
+    # serial, fed the same post-resume data -> bit-identical digest
+    print("== phase 2: uninterrupted dp=4 baseline from the same serial")
+    base_dir = os.path.join(work, "baseline_ckpts")
+    os.makedirs(base_dir, exist_ok=True)
+    serial = ev[0]["serial"] if ev else 0
+    src = os.path.join(victim_dir, f"checkpoint_{serial}")
+    if os.path.isdir(src):
+        shutil.copytree(src, os.path.join(base_dir,
+                                          f"checkpoint_{serial}"))
+    rc, _, err2 = _spawn_el(base_dir, os.path.join(work, "baseline.json"),
+                            {}, n_devices=4)
+    base = _load(os.path.join(work, "baseline.json"))
+    report["phases"]["baseline"] = {"rc": rc, "result": base}
+    check("baseline_resumed_at_cursor",
+          rc == 0 and base and base["start_step"] == EL_RESUME_STEP
+          and [s for _e, s, _k in base["trace"]] == remaining,
+          f"rc={rc} start={base and base['start_step']}")
+    check("final_params_digest_matches_dp4_baseline",
+          vic and base
+          and vic["params_sha256"] == base["params_sha256"],
+          "rescaled resume == uninterrupted dp=4 run, bit for bit")
+
+    # -- phase 3: negative control — FLAGS_elastic=0 must die typed
+    print("== phase 3: negative control (FLAGS_elastic=0 -> typed death)")
+    rc, _, err3 = _spawn_el(
+        os.path.join(work, "neg_ckpts"), os.path.join(work, "neg.json"),
+        {"FLAGS_fault_plan": fault, "EL_SURVIVORS": "4",
+         "FLAGS_elastic": "0"})
+    report["phases"]["negative"] = {"rc": rc,
+                                    "stderr_tail": err3[-1500:]}
+    check("elastic_disabled_dies", rc not in (0, None), f"rc={rc}")
+    check("death_is_typed_DeviceLostError", "DeviceLostError" in err3,
+          "typed error on stderr")
+    check("no_silent_recovery_attempted", "rescaled" not in err3)
+
+    # -- phase 4: retry must never absorb a DeviceLostError (in-process)
+    print("== phase 4: retry-absorption control (in-process)")
+    from paddle_tpu.resilience import elastic as _el
+    from paddle_tpu.resilience.retry import call_with_retry
+    attempts = {"n": 0}
+
+    def dead_chip():
+        attempts["n"] += 1
+        raise _el.DeviceLostError("chip gone", site="parallel_step")
+
+    typed = False
+    try:
+        call_with_retry("step", dead_chip)
+    except _el.DeviceLostError:
+        typed = True
+    except Exception:
+        pass
+    check("retry_never_absorbs_device_loss",
+          typed and attempts["n"] == 1,
+          f"typed={typed} attempts={attempts['n']} (must be exactly 1)")
+    report["phases"]["retry_control"] = {"typed": typed,
+                                         "attempts": attempts["n"]}
+
+    # -- phase 5: capacity returns — rescale back up, no restore
+    print("== phase 5: upscale (survivors report 4 then 8, "
+          "FLAGS_elastic_upscale_after_steps=2)")
+    rc, _, err5 = _spawn_el(
+        os.path.join(work, "up_ckpts"), os.path.join(work, "up.json"),
+        {"FLAGS_fault_plan": fault, "EL_SURVIVORS": "4,8",
+         "FLAGS_elastic_upscale_after_steps": "2"})
+    up = _load(os.path.join(work, "up.json"))
+    report["phases"]["upscale"] = {"rc": rc, "result": up}
+    uev = (up or {}).get("elastic_events") or []
+    check("upscale_completed", rc == 0 and up
+          and up["final_step"] == EL_STEPS, f"rc={rc}")
+    check("upscaled_4_to_8_when_capacity_returned",
+          len(uev) == 2 and uev[1]["direction"] == "up"
+          and uev[1]["old"] == "dp=4" and uev[1]["new"] == "dp=8",
+          f"events: {uev}")
+    check("upscale_kept_batch_sequence_exact",
+          up is not None and post_resume(up) == remaining,
+          f"post-resume trace {post_resume(up)}")
+
+    ok = all(c[1] for c in checks)
+    report["checks"] = [{"name": n, "ok": o, "detail": d}
+                        for n, o, d in checks]
+    report["status"] = "ok" if ok else "fail"
+    print(f"chaos elastic gate: "
+          f"{len([c for c in checks if c[1]])}/{len(checks)} checks -> "
+          f"{'ok' if ok else 'FAIL'}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+        print(f"chaos elastic artifact written to {args.json}")
+    if not args.keep_workdir and ok:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0 if (not args.check or ok) else 1
 
 
 # ---------------------------------------------------------------------------
@@ -606,6 +904,13 @@ def main(argv=None) -> int:
                          "checkpoints — kill inside one shard write, "
                          "elastic 8->4->1 restore, watchdog-vs-hang "
                          "(resilience.distributed)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="preemption-tolerance leg: injected device loss "
+                         "at dp=8 must auto-rescale to dp=4, resume from "
+                         "the last verified serial with an exact batch "
+                         "trace and a digest equal to an uninterrupted "
+                         "dp=4 baseline; FLAGS_elastic=0 must die typed "
+                         "(resilience.elastic)")
     ap.add_argument("--workdir", default=None,
                     help="scratch dir for checkpoints/results "
                          "(default: .chaos_check / .chaos_check_dist)")
@@ -616,6 +921,8 @@ def main(argv=None) -> int:
                     help=argparse.SUPPRESS)
     ap.add_argument("--mc-verify", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--el-worker", action="store_true",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--ckpt-dir", help=argparse.SUPPRESS)
     ap.add_argument("--result", help=argparse.SUPPRESS)
     ap.add_argument("--total-steps", type=int, default=TOTAL_STEPS,
@@ -625,15 +932,21 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.workdir is None:
         args.workdir = os.path.join(
-            REPO, ".chaos_check_dist" if args.multichip else ".chaos_check")
+            REPO, ".chaos_check_elastic" if args.elastic
+            else ".chaos_check_dist" if args.multichip
+            else ".chaos_check")
     if args.worker:
         return run_worker(args)
     if args.mc_worker:
         return run_multichip_worker(args)
     if args.mc_verify:
         return run_verify_worker(args)
+    if args.el_worker:
+        return run_elastic_worker(args)
     if args.multichip:
         return run_multichip_gate(args)
+    if args.elastic:
+        return run_elastic_gate(args)
     return run_gate(args)
 
 
