@@ -95,6 +95,27 @@ if ! grep -q -- "-> FAIL" "$SERVING_NEG_LOG"; then
   exit 1
 fi
 
+echo "== trace gate (paddle_tpu.trace: every request in exactly one complete"
+echo "   trace, flight-recorder dumps on injected batch fault + watchdog hang,"
+echo "   cost-model FLOPs within 10% of analytic, near-zero off overhead;"
+echo "   MFU figures land in ci_trace_report.json)"
+JAX_PLATFORMS=cpu python tools/trace_check.py --check \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_trace_report.json" | tail -10
+echo "== trace negative control (flight recorder disabled: the gate must"
+echo "   FAIL — the dump is what carries the fault context)"
+TRACE_NEG_LOG="${CI_ARTIFACT_DIR:-.}/ci_trace_negative.log"
+if JAX_PLATFORMS=cpu python tools/trace_check.py --check \
+     --negative-control > "$TRACE_NEG_LOG" 2>&1; then
+  echo "trace_check --check did NOT fail with the flight recorder disabled" >&2
+  exit 1
+fi
+# non-zero exit must be the gate tripping, not the harness crashing
+if ! grep -q -- "-> FAIL" "$TRACE_NEG_LOG"; then
+  echo "trace negative control exited non-zero WITHOUT tripping the gate:" >&2
+  tail -20 "$TRACE_NEG_LOG" >&2
+  exit 1
+fi
+
 echo "== chaos multichip gate (resilience.distributed: kill inside one shard"
 echo "   write -> serial unpublished + bit-identical resume; elastic 8->4->1"
 echo "   restore; watchdog converts an injected hang, and without it the"

@@ -36,6 +36,7 @@ from .transpiler import (DistributeTranspiler, DistributeTranspilerConfig,
                          memory_optimize, release_memory)
 from . import monitor
 from . import profiler
+from . import trace
 from . import regularizer
 from . import resilience
 from . import serving

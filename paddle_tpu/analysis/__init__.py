@@ -42,6 +42,8 @@ from .pass_manager import (ALL_ANALYSIS_PASSES, VERIFY_PASSES, FunctionPass,
                            run_transform_pipeline, run_verify_pipeline)
 from . import static_checks
 from .static_checks import (DceDecision, DeadCodeReport, dce_program)
+from . import cost_model
+from .cost_model import CostReport, estimate_cost
 
 __all__ = [
     "CODES", "Diagnostic", "ProgramVerificationError", "Severity",
@@ -57,4 +59,5 @@ __all__ = [
     "run_verify_pipeline", "run_transform_pipeline", "clear_analysis_caches",
     "ALL_ANALYSIS_PASSES", "VERIFY_PASSES",
     "static_checks", "DceDecision", "DeadCodeReport", "dce_program",
+    "cost_model", "CostReport", "estimate_cost",
 ]

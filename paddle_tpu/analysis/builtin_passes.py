@@ -94,6 +94,12 @@ def _dead_code_pass(program, ctx):
     return check_dead_code(program, ctx)
 
 
+def _cost_model_pass(program, ctx):
+    from .cost_model import check_cost_model
+
+    return check_cost_model(program, ctx)
+
+
 def _dce_pass(program, ctx):
     """Opt-in dead-code elimination, proven by the fidelity witness in
     ``static_checks.dce_program`` (refuses rather than risk a wrong
@@ -116,6 +122,7 @@ def register_builtins(reg: PassRegistry) -> None:
     # directly; it does NOT consume the liveness chains, so it declares no
     # dependency (requesting only dead_code must not drag PT50x findings in)
     reg.register(FunctionPass(_dead_code_pass, "dead_code", ANALYSIS))
+    reg.register(FunctionPass(_cost_model_pass, "cost_model", ANALYSIS))
     reg.register(FunctionPass(_auto_remat_pass, "auto_remat", TRANSFORM,
                               invalidates=("*",)))
     reg.register(FunctionPass(_dce_pass, "dce", TRANSFORM,

@@ -43,6 +43,7 @@ Built-in passes (docs/ANALYSIS.md has the full table):
 | dtype_shape_check | analysis  | —           | PT70x whole-program replay |
 | donation_race     | analysis  | liveness    | PT71x donation/alias races |
 | dead_code         | analysis  | —           | PT72x transitively dead ops |
+| cost_model        | analysis  | —           | FLOP/byte CostReport (no diagnostics) |
 | auto_remat        | transform | —           | Pass 6 rebuild (FLAGS_auto_recompute) |
 | dce               | transform | dead_code   | opt-in dead-op elimination |
 """

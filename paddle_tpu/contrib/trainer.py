@@ -47,6 +47,7 @@ import numpy as np
 from .. import io as io_mod
 from .. import monitor as _monitor
 from .. import resilience as _resilience
+from .. import trace as _trace
 from ..executor import CPUPlace, Executor, Scope, scope_guard
 from ..framework import Program, program_guard
 from ..parallel.compiled_program import CompiledProgram
@@ -186,7 +187,9 @@ class Trainer:
     def _save_checkpoint(self):
         serials = self._serials()
         serial = (serials[-1] + 1) if serials else 0
-        with scope_guard(self.scope):
+        with scope_guard(self.scope), \
+                _trace.span("trainer.checkpoint", serial=serial,
+                            step=self._step):
             io_mod.save_checkpoint(self.exe, self._ckpt_path(serial),
                                    self.main_program,
                                    meta={"step": self._step,
@@ -330,7 +333,24 @@ class Trainer:
         VERIFIED serial and queue the data-cursor fast-forward. Raises
         (typed) when elastic is off, the topology cannot be satisfied
         (PT610/PT611), the rescale budget is spent (PT612) or nothing
-        restorable exists (PT614) — recovery is never silent either way."""
+        restorable exists (PT614) — recovery is never silent either way.
+        The whole episode is one trace (``trainer.elastic_recover``) so
+        the flight recorder shows rescale + restore as spans, not logs."""
+        recover_span = _trace.root_span(
+            "trainer.elastic_recover", cause=type(err).__name__,
+            step=self._step)
+        recover_span.__enter__()
+        try:
+            out = self._elastic_recover_body(err, prog)
+        except BaseException as e:
+            recover_span.set_attribute("outcome", "failed")
+            recover_span.__exit__(type(e), e, None)
+            raise
+        recover_span.set_attribute("outcome", "recovered")
+        recover_span.__exit__(None, None, None)
+        return out
+
+    def _elastic_recover_body(self, err, prog) -> CompiledProgram:
         from ..flags import flag
         from ..parallel.sharding import make_mesh
         from ..resilience.distributed import WatchdogTimeout, mesh_axes
@@ -557,6 +577,9 @@ class Trainer:
                     stopped = self._run_epoch(epoch, event_handler,
                                               feeder, reader, prog, skip)
                 except (_elastic.DeviceLostError, WatchdogTimeout) as e:
+                    # detection already dumped the flight recorder (the
+                    # device-loss classifier / the watchdog expiry); the
+                    # recovery episode itself is traced below
                     prog = self._elastic_recover(e, prog)
                     epoch, skip = self._consume_resume_cursor(reader)
                     continue   # re-enter from the restored cursor
@@ -590,79 +613,128 @@ class Trainer:
             begin = BeginStepEvent(epoch, step)
             event_handler(begin)
             fetches = [self.loss.name] if begin.fetch_metrics else []
+            # one trace per training step (root span; data fetch,
+            # executor dispatch, divergence checks and checkpoint writes
+            # land as children). The trace covers everything from feed
+            # build through the post-step checkpoint decision, so a
+            # device loss or watchdog hang leaves a complete error-status
+            # step trace in the flight recorder.
+            step_span = _trace.root_span("trainer.step", epoch=epoch,
+                                         step=step,
+                                         global_step=self._step)
+            step_span.__enter__()
+            step_err: Optional[BaseException] = None
             t0 = time.perf_counter()
-            # the batch the elastic planner must keep divisible across a
-            # surviving dp width (PT613 refusal)
             try:
-                self._last_global_batch = len(batch)
-            except TypeError:
-                pass
-            # belt and braces for fully-async dispatch: a real device
-            # loss can surface only HERE, at the metric materialization
-            # — classify it typed so the elastic recovery still fires
-            with _elastic.device_loss_classification("parallel_step"):
-                vals = self.exe.run(prog, feed=feeder.feed(batch),
-                                    fetch_list=fetches)
-                metrics = [float(np.asarray(v).reshape(-1)[0])
-                           for v in vals]
-            if self._restored_step is not None:
-                # a divergence restore rolled this step back mid-
-                # run: the scope holds the checkpoint's state, so
-                # the counter adopts the checkpoint's step instead
-                # of advancing past state that no longer exists
-                self._step = self._restored_step
-                self._restored_step = None
-                if self._resume_cursor is not None:
-                    # the checkpoint carries a data cursor: rewind the
-                    # data stream with the state (no EndStepEvent — the
-                    # step that just ran was rolled back)
-                    raise _EpochRewind()
-                # legacy checkpoint without a cursor: keep the historic
-                # continue-forward semantics
-            else:
-                self._step += 1
-            # the committed data position: the NEXT batch is step+1 of
-            # this epoch (checkpointed with the state as data_cursor)
-            self._cursor = _elastic.DataCursor.capture(epoch, step + 1,
-                                                       reader)
-            if _monitor.enabled():
-                _monitor.counter(
-                    "trainer_steps_total",
-                    "steps run by contrib.Trainer.train").inc()
-                _monitor.histogram(
-                    "trainer_step_seconds",
-                    "Trainer step wall time (feed build + executor "
-                    "dispatch + metric fetch)").observe(
-                    time.perf_counter() - t0)
-                if metrics:
-                    _monitor.gauge(
-                        "trainer_last_loss",
-                        "most recent fetched loss").set(metrics[0])
-            event_handler(EndStepEvent(epoch, step, metrics))
-            self._maybe_upscale(prog)
-            saved_this_step = False
-            if self._ckpt and self._step % \
-                    self._ckpt.step_interval == 0:
-                self._save_checkpoint()
-                saved_this_step = True
-            if _graceful.shutdown_requested():
-                # preemption notice: the in-flight step completed above;
-                # write the final verified checkpoint (data cursor
-                # included) and unwind so the process can exit 0 — but
-                # never a byte-identical duplicate of the interval save
-                # that just ran (the grace window is for exiting)
-                if self._ckpt and not saved_this_step:
-                    self._save_checkpoint()
-                self.interrupted = True
+                # the batch the elastic planner must keep divisible
+                # across a surviving dp width (PT613 refusal)
+                try:
+                    self._last_global_batch = len(batch)
+                except TypeError:
+                    pass
+                with _trace.span("trainer.data"):
+                    fd = feeder.feed(batch)
+                # belt and braces for fully-async dispatch: a real device
+                # loss can surface only HERE, at the metric materialization
+                # — classify it typed so the elastic recovery still fires
+                with _elastic.device_loss_classification("parallel_step"):
+                    vals = self.exe.run(prog, feed=fd, fetch_list=fetches)
+                    metrics = [float(np.asarray(v).reshape(-1)[0])
+                               for v in vals]
+            except BaseException as e:
+                step_err = e
+                raise
+            finally:
+                if step_err is not None:
+                    step_span.set_attribute("outcome",
+                                            type(step_err).__name__)
+                    step_span.__exit__(type(step_err), step_err, None)
+            post_err = None
+            try:
+                if self._restored_step is not None:
+                    # a divergence restore rolled this step back mid-
+                    # run: the scope holds the checkpoint's state, so
+                    # the counter adopts the checkpoint's step instead
+                    # of advancing past state that no longer exists
+                    self._step = self._restored_step
+                    self._restored_step = None
+                    if self._resume_cursor is not None:
+                        # the checkpoint carries a data cursor: rewind the
+                        # data stream with the state (no EndStepEvent — the
+                        # step that just ran was rolled back)
+                        step_span.set_attribute("outcome",
+                                                "divergence_rewind")
+                        raise _EpochRewind()
+                    # legacy checkpoint without a cursor: keep the historic
+                    # continue-forward semantics
+                else:
+                    self._step += 1
+                # the committed data position: the NEXT batch is step+1 of
+                # this epoch (checkpointed with the state as data_cursor)
+                self._cursor = _elastic.DataCursor.capture(epoch, step + 1,
+                                                           reader)
                 if _monitor.enabled():
                     _monitor.counter(
-                        "trainer_graceful_exits_total",
-                        "train() calls unwound by a graceful-shutdown "
-                        "request after a final checkpoint").inc()
-                logger.warning(
-                    "graceful shutdown: step %d checkpointed, train() "
-                    "returning cleanly", self._step)
-                return True
+                        "trainer_steps_total",
+                        "steps run by contrib.Trainer.train").inc()
+                    _monitor.histogram(
+                        "trainer_step_seconds",
+                        "Trainer step wall time (feed build + executor "
+                        "dispatch + metric fetch)").observe(
+                        time.perf_counter() - t0)
+                    if metrics:
+                        _monitor.gauge(
+                            "trainer_last_loss",
+                            "most recent fetched loss").set(metrics[0])
+                event_handler(EndStepEvent(epoch, step, metrics))
+                self._maybe_upscale(prog)
+                saved_this_step = False
+                if self._ckpt and self._step % \
+                        self._ckpt.step_interval == 0:
+                    self._save_checkpoint()
+                    saved_this_step = True
+                if _graceful.shutdown_requested():
+                    # preemption notice: the in-flight step completed
+                    # above; write the final verified checkpoint (data
+                    # cursor included) and unwind so the process can exit
+                    # 0 — but never a byte-identical duplicate of the
+                    # interval save that just ran (the grace window is
+                    # for exiting)
+                    if self._ckpt and not saved_this_step:
+                        self._save_checkpoint()
+                    self.interrupted = True
+                    if _monitor.enabled():
+                        _monitor.counter(
+                            "trainer_graceful_exits_total",
+                            "train() calls unwound by a graceful-shutdown "
+                            "request after a final checkpoint").inc()
+                    logger.warning(
+                        "graceful shutdown: step %d checkpointed, train() "
+                        "returning cleanly", self._step)
+                    step_span.set_attribute("outcome", "graceful_exit")
+                    return True
+            except BaseException as e:
+                post_err = e
+                raise
+            finally:
+                # close the step trace on every unwind; the dispatch-
+                # failure path closed it in the except block above. A
+                # post-dispatch failure (event handler, checkpoint write,
+                # upscale) must NOT be mislabeled 'ok' — the flight
+                # recorder consulted for that incident would lie.
+                # _EpochRewind is control flow, not an error: its span
+                # closes clean with the 'divergence_rewind' outcome.
+                if post_err is not None \
+                        and not isinstance(post_err, _EpochRewind):
+                    if step_span.attrs.get("outcome") is None:
+                        step_span.set_attribute("outcome",
+                                                type(post_err).__name__)
+                    step_span.__exit__(type(post_err), post_err, None)
+                else:
+                    if step_span.attrs.get("outcome") is None \
+                            and not step_span.error:
+                        step_span.set_attribute("outcome", "ok")
+                    step_span.__exit__(None, None, None)
         event_handler(EndEpochEvent(epoch))
         # next batch after a completed epoch is the next epoch's first
         self._cursor = _elastic.DataCursor.capture(epoch + 1, 0, reader)

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import monitor as _monitor
+from . import trace as _trace
 from .core.types import np_dtype
 from .framework import Program, Variable, default_main_program
 from .lowering import LowerCtx, lower_block, lower_op
@@ -158,6 +159,16 @@ def _feed_host_bytes(v) -> int:
 
 def _live_bytes(vals) -> int:
     return sum(int(getattr(v, "nbytes", 0) or 0) for v in vals)
+
+
+def _feed_batch_rows(feed) -> int:
+    """Leading feed dim (the cost-model batch); no host transfer."""
+    batch = 1
+    for v in (feed or {}).values():
+        shape, _ = _shape_dtype_sig(v)
+        if shape:
+            batch = max(batch, int(shape[0]))
+    return batch
 
 
 def _has_nonfinite(v) -> bool:
@@ -592,13 +603,17 @@ class Executor:
         program = self._maybe_auto_remat(program, feed, fetch_names)
         self._verify_once(program, fetch_names)
         mrec = _monitor.step_begin("run", program)
-        try:
-            return self._run_body(program, feed, fetch_names, scope,
-                                  return_numpy, use_program_cache, mrec)
-        finally:
-            # always paired with step_begin — a step that raises (e.g.
-            # FLAGS_check_nan_inf) still counts and hooks stay in sync
-            _monitor.step_end(mrec)
+        # child of whatever request/step trace is ambient on this thread
+        # (serving attaches the request root; the Trainer its step root)
+        with _trace.span("executor.run",
+                         program=int(getattr(program, "_serial", -1))):
+            try:
+                return self._run_body(program, feed, fetch_names, scope,
+                                      return_numpy, use_program_cache, mrec)
+            finally:
+                # always paired with step_begin — a step that raises (e.g.
+                # FLAGS_check_nan_inf) still counts and hooks stay in sync
+                _monitor.step_end(mrec)
 
     def _run_body(self, program, feed, fetch_names, scope, return_numpy,
                   use_program_cache, mrec):
@@ -607,6 +622,7 @@ class Executor:
         if mrec is not None:
             mrec.fetch_names = tuple(fetch_names)
             mrec.feed_bytes = sum(_feed_host_bytes(v) for v in feed.values())
+            mrec.batch_rows = _feed_batch_rows(feed)
         feed_vals = [self._to_device_array(feed[n], program, n)
                      for n in step.feed_names]
 
@@ -655,7 +671,10 @@ class Executor:
             # watchdog-armed dispatch: a hang here (injected via the
             # 'hang' fault site, or a real stuck collective) is dumped +
             # raised as WatchdogTimeout under FLAGS_step_timeout_s
-            with RecordEvent("executor::step"), \
+            with _trace.span("executor.step",
+                             cache_hit=bool(mrec.cache_hit)
+                             if mrec is not None else None), \
+                    RecordEvent("executor::step"), \
                     _dist.watchdog_section("step", program=program) as tok:
                 _faults.fault_point("hang")
                 try:
@@ -748,13 +767,17 @@ class Executor:
             mrec.iterations = int(steps)
             mrec.fetch_names = tuple(fetch_names)
             mrec.feed_bytes = sum(_feed_host_bytes(v) for v in feed.values())
+            mrec.batch_rows = _feed_batch_rows(feed)
         _monitor.record_cache_lookup("chained", step is not None)
-        try:
-            return self._run_chained_body(program, feed, fetch_names, steps,
-                                          scope, return_numpy, key, step,
-                                          feed_sig, mrec)
-        finally:
-            _monitor.step_end(mrec)
+        with _trace.span("executor.run_chained",
+                         program=int(getattr(program, "_serial", -1)),
+                         steps=int(steps)):
+            try:
+                return self._run_chained_body(program, feed, fetch_names,
+                                              steps, scope, return_numpy,
+                                              key, step, feed_sig, mrec)
+            finally:
+                _monitor.step_end(mrec)
 
     def _run_chained_body(self, program, feed, fetch_names, steps, scope,
                           return_numpy, key, step, feed_sig, mrec):
@@ -1117,8 +1140,11 @@ class Executor:
                     return compiled, t1 - t0, time.perf_counter() - t1
 
             try:
-                step._aot, t_trace, t_compile = \
-                    call_with_retry("compile", _build)
+                with _trace.span(
+                        "executor.compile",
+                        program=int(getattr(step.program, "_serial", -1))):
+                    step._aot, t_trace, t_compile = \
+                        call_with_retry("compile", _build)
             except RetryExhaustedError as e:
                 if isinstance(e.last_error, _faults.InjectedFault):
                     # a scripted fault outlasting the retry budget must

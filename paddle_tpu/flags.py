@@ -114,6 +114,31 @@ _DEFS: Dict[str, tuple] = {
                                   " walk — contrib.Trainer wires it — "
                                   "and keep training; escalates to raise "
                                   "when nothing restorable exists)"),
+    "trace": (bool, False,
+              "structured span tracing (paddle_tpu.trace): request/step "
+              "trace-ID propagation through serving, executor, trainer, "
+              "retry and the resilience failure paths, feeding the "
+              "flight recorder and the Chrome/JSONL exporters. Off "
+              "(default) the hot paths pay one flag read and a no-op "
+              "singleton — tools/trace_check.py gates the overhead. "
+              "docs/OBSERVABILITY.md"),
+    "trace_buffer_size": (int, 4096,
+                          "finished spans kept in the bounded trace "
+                          "collector (oldest evicted); exporters and "
+                          "trace_tree read from this buffer"),
+    "flight_recorder_size": (int, 256,
+                             "spans kept in the flight-recorder ring "
+                             "dumped into the diagnosis when a "
+                             "WatchdogTimeout / DeviceLostError / "
+                             "replica divergence / BatchFailed fires; "
+                             "0 disables the recorder (incidents then "
+                             "ship without span context — the "
+                             "trace_check negative control)"),
+    "device_peak_tflops": (float, 197.0,
+                           "accelerator peak dense TF/s used for the "
+                           "cost-model MFU gauges (default: v5e bf16 "
+                           "peak; set per deployment). "
+                           "docs/PERF_NOTES.md"),
     "fault_seed": (int, 0,
                    "seed for probabilistic fault-plan rules and retry "
                    "jitter — the same plan+seed replays identically"),
@@ -226,6 +251,13 @@ _DEFS: Dict[str, tuple] = {
 
 _overrides: Dict[str, Any] = {}
 
+# bumped on every set_flags call: cheap change-detection for hot-path
+# callers that memoize a flag value (paddle_tpu.trace.enabled caches
+# FLAGS_trace against this, so the disabled tracing path costs an int
+# compare instead of an env read per span). Env-var mutations AFTER the
+# first read are not observed — the documented gflags-style contract.
+_set_epoch = 0
+
 
 def _coerce(typ, raw):
     if typ is bool:
@@ -321,6 +353,7 @@ def _parse_xla_options(raw: str) -> Dict[str, Any]:
 
 def set_flags(flags_dict: Dict[str, Any]) -> None:
     """reference fluid.set_flags({'FLAGS_check_nan_inf': 1})."""
+    global _set_epoch
     for k, v in flags_dict.items():
         name = k.replace("FLAGS_", "")
         if name not in _DEFS:
@@ -328,3 +361,4 @@ def set_flags(flags_dict: Dict[str, Any]) -> None:
                            f"{sorted('FLAGS_' + n for n in _DEFS)}")
         typ = _DEFS[name][0]
         _overrides[name] = _coerce(typ, v)
+    _set_epoch += 1

@@ -37,6 +37,7 @@ class StepRecord:
     donated_buffers: int = 0         # state vars donated to XLA
     kept_buffers: int = 0            # state vars kept (donation-unsafe/copied)
     donated_bytes: int = 0           # live bytes of the donated buffers
+    batch_rows: int = 0              # leading feed dim (cost-model batch)
     fetch_names: Tuple[str, ...] = ()
 
     def to_dict(self) -> dict:

@@ -157,18 +157,24 @@ class CompiledProgram:
         # CompiledProgram's own step cache apart from the plain variant
         program = exe._maybe_auto_remat(self._program, feed, fetch_names)
         mrec = _monitor.step_begin("parallel", program)
-        try:
-            # classification wraps the WHOLE dispatch, not just the jit
-            # call: with async dispatch (watchdog unarmed) a real device
-            # loss only surfaces when a result is read — at
-            # unpack_step_result or the return_numpy materialization —
-            # and must still come out typed (resilience.elastic)
-            with _elastic.device_loss_classification("parallel_step"):
-                return self._run_body(exe, program, feed, fetch_names,
-                                      scope, return_numpy, mrec)
-        finally:
-            # paired with step_begin even when the step raises
-            _monitor.step_end(mrec)
+        from .. import trace as _trace
+
+        with _trace.span("executor.parallel_step",
+                         program=int(getattr(program, "_serial", -1)),
+                         mesh=str(dict(self._mesh.shape))
+                         if self._mesh is not None else ""):
+            try:
+                # classification wraps the WHOLE dispatch, not just the jit
+                # call: with async dispatch (watchdog unarmed) a real device
+                # loss only surfaces when a result is read — at
+                # unpack_step_result or the return_numpy materialization —
+                # and must still come out typed (resilience.elastic)
+                with _elastic.device_loss_classification("parallel_step"):
+                    return self._run_body(exe, program, feed, fetch_names,
+                                          scope, return_numpy, mrec)
+            finally:
+                # paired with step_begin even when the step raises
+                _monitor.step_end(mrec)
 
     def _run_body(self, exe, program, feed, fetch_names, scope,
                   return_numpy, mrec):
@@ -177,8 +183,11 @@ class CompiledProgram:
         step = self._get_compiled(exe, program, feed, fetch_names, scope,
                                   mrec=mrec)
         if mrec is not None:
+            from ..executor import _feed_batch_rows
+
             mrec.feed_bytes = sum(_feed_host_bytes(v)
                                   for v in feed.values())
+            mrec.batch_rows = _feed_batch_rows(feed)
         multiproc = jax.process_count() > 1
         batch_shard = NamedSharding(
             self._mesh, P("dp") if "dp" in self._mesh.axis_names else P())

@@ -38,7 +38,11 @@ log = logging.getLogger("paddle_tpu.profiler")
 _lock = threading.Lock()
 _trace_dir: Optional[str] = None
 _host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
-_host_spans = []  # (name, t0_s, t1_s, small_tid) while profiling
+# (name, t0_s, t1_s, small_tid, epoch0_s) while profiling: t0/t1 are
+# perf_counter (durations), epoch0 is time.time() at __enter__ — the
+# shared wall-clock anchor that lets tools/timeline.py merge these host
+# events with paddle_tpu.trace spans on one Chrome timeline
+_host_spans = []
 _tid_map = {}     # thread ident -> stable small timeline row id
 
 
@@ -79,8 +83,9 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 
         path = os.path.join(trace_dir, "host_events.json")
         with open(path, "w") as f:
-            json.dump([{"name": n, "t0": a, "t1": b, "tid": t}
-                       for n, a, b, t in spans], f)
+            json.dump([{"name": n, "t0": a, "t1": b, "tid": t,
+                        "epoch": e}
+                       for n, a, b, t, e in spans], f)
         report["spans_path"] = path
     return report
 
@@ -111,6 +116,9 @@ class RecordEvent:
 
     def __enter__(self):
         self._t0 = time.perf_counter()
+        # wall-clock anchor at open: perf_counter deltas alone cannot be
+        # merged with trace spans or other processes' dumps
+        self._epoch0 = time.time()
         self._ann.__enter__()
         return self
 
@@ -124,7 +132,8 @@ class RecordEvent:
             rec[1] += t1 - self._t0
             if _trace_dir is not None:
                 tid = _tid_map.setdefault(ident, len(_tid_map))
-                _host_spans.append((self.name, self._t0, t1, tid))
+                _host_spans.append((self.name, self._t0, t1, tid,
+                                    self._epoch0))
         return False
 
 

@@ -29,9 +29,11 @@ __all__ = ["Deadline", "DeadlineExceeded"]
 class DeadlineExceeded(TimeoutError):
     """A wall-clock budget ran out. ``transient = False``: the retry
     classifier must never absorb an expired deadline (a TimeoutError is
-    otherwise retryable)."""
+    otherwise retryable). ``trace_id`` is stamped by the serving engine
+    when the expired operation belongs to a traced request."""
 
     transient = False
+    trace_id = ""
 
     def __init__(self, what: str, budget_s: float, elapsed_s: float):
         self.what = what

@@ -483,6 +483,12 @@ def handle_divergence(diverged: Sequence[str], path: str = "parallel",
         _monitor.counter(
             "resilience_divergence_detected_total",
             "cross-replica divergence detections").labels(path=path).inc()
+    from .. import trace as _trace
+
+    _trace.record_incident(
+        "replica_divergence",
+        detail=f"path {path}, axis {axis}: "
+               f"{', '.join(list(diverged)[:5])}")
     policy = str(flag("replica_divergence_policy")).strip().lower()
     if policy not in ("raise", "restore"):
         raise ValueError(
@@ -609,6 +615,31 @@ def _dump_section(s: _Section) -> str:
         mark = " [hung section]" if tid == s.thread_id else ""
         lines.append(f"-- thread '{name}' ({tid}){mark} --")
         lines.append("".join(traceback.format_stack(frame)).rstrip())
+    # flight recorder: the hang's diagnosis ships with the last N trace
+    # spans (the hung request/step's chain among them) — incidents() /
+    # the ci_trace_report artifact carry the structured form
+    try:
+        from .. import trace as _trace
+
+        incident = _trace.record_incident(
+            "watchdog_timeout",
+            detail=f"section '{s.section}' ({s.detail or 'no detail'}) "
+                   f"exceeded {s.timeout:g}s")
+        if incident["recent_spans"]:
+            lines.append(f"-- flight recorder: last "
+                         f"{len(incident['recent_spans'])} span(s) --")
+            for d in incident["recent_spans"][-12:]:
+                lines.append(
+                    f"  {d['name']} trace={d['trace_id']} "
+                    f"status={d['status']} "
+                    f"dur={d['duration_s'] if d['duration_s'] is not None else '?'} "
+                    f"attrs={d['attrs']}")
+        elif not incident["flight_recorder_enabled"]:
+            lines.append("-- flight recorder: disabled (FLAGS_trace / "
+                         "FLAGS_flight_recorder_size) — no span context --")
+    except Exception:
+        logger.exception("flight-recorder dump failed (diagnosis "
+                         "continues without span context)")
     text = "\n".join(lines)
     logger.error("%s", text)
     print(text, file=sys.stderr, flush=True)

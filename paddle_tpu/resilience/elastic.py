@@ -143,8 +143,11 @@ _DEVICE_LOSS_PATTERNS = tuple(re.compile(p, re.IGNORECASE) for p in (
 def record_device_lost(site: str) -> None:
     """One definition of the ``elastic_device_lost_total`` counter for
     every detection site (classifier, watchdog escalation) — two literal
-    copies would drift apart and split the series."""
+    copies would drift apart and split the series. Also the one choke
+    point where the flight recorder dumps: a device loss ships with the
+    last N trace spans (the dying step/request chain among them)."""
     from .. import monitor as _monitor
+    from .. import trace as _trace
 
     if _monitor.enabled():
         _monitor.counter(
@@ -152,6 +155,7 @@ def record_device_lost(site: str) -> None:
             "device losses detected (classified from the jax/XLA error "
             "zoo, injected, or escalated from a watchdog-diagnosed "
             "parallel-step hang)").labels(site=site).inc()
+    _trace.record_incident("device_lost", detail=f"site {site}")
 
 
 def _chain(exc: BaseException):

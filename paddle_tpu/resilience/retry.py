@@ -156,12 +156,21 @@ def call_with_retry(site: str, fn: Callable, *args,
     budget is therefore measured from the first failure, not the call."""
     from .. import monitor as _monitor
 
+    from .. import trace as _trace
+
     pol = policy
     rng = deadline = None
     attempt = 0
+    traced = _trace.enabled()
     while True:
         attempt += 1
         try:
+            if traced:
+                # one span per attempt: a request trace shows each retry
+                # as its own interval with the attempt number and outcome
+                with _trace.span("retry." + site, site=site,
+                                 attempt=attempt):
+                    return fn(*args, **kwargs)
             return fn(*args, **kwargs)
         except Exception as e:
             if not is_transient(e):

@@ -54,11 +54,13 @@ import itertools
 import logging
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from .. import monitor as _monitor
+from .. import trace as _trace
 from ..executor import Executor, Scope
 from ..framework import Variable
 from ..resilience import faults as _faults
@@ -81,9 +83,13 @@ OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 class ServingError(RuntimeError):
     """Base of every typed serving rejection/failure. ``transient =
     False``: the retry classifier must never absorb one — each is a
-    deliberate terminal outcome, not an infrastructure hiccup."""
+    deliberate terminal outcome, not an infrastructure hiccup.
+    ``trace_id`` names the request's trace when ``FLAGS_trace`` is on
+    (every typed outcome is attributable to one specific request —
+    ``accounting()['recent_outcomes']`` carries the same ids)."""
 
     transient = False
+    trace_id = ""
 
 
 class Overloaded(ServingError):
@@ -183,7 +189,11 @@ class ServingConfig:
 
 class ServingFuture:
     """One request's pending terminal outcome. Settled exactly once by
-    the engine; a second settle attempt is an engine bug and raises."""
+    the engine; a second settle attempt is an engine bug and raises.
+    ``trace_id`` (non-empty under ``FLAGS_trace``) names the request's
+    trace — the handle for pulling its span chain from the collector."""
+
+    trace_id = ""
 
     def __init__(self):
         self._event = threading.Event()
@@ -231,6 +241,10 @@ class _Request:
     deadline: Optional[Deadline]
     submitted: float
     future: ServingFuture
+    # root span of this request's trace (trace.NOOP_SPAN when off) and
+    # the in-flight dispatch child opened by the dispatch thread
+    span: Any = _trace.NOOP_SPAN
+    dispatch_span: Any = _trace.NOOP_SPAN
 
 
 # ---------------------------------------------------------------------------
@@ -298,6 +312,9 @@ class ServingEngine:
         self._acct = {"submitted": 0, "completed": 0, "failed": 0,
                       "shed": 0, "deadline_exceeded": 0, "circuit_open": 0,
                       "rejected_fault": 0, "rejected_stopped": 0}
+        # last N terminal outcomes with their trace ids (accounting()):
+        # a failed load_check leg names the exact requests that missed
+        self._recent_outcomes: deque = deque(maxlen=64)
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServingEngine":
@@ -396,21 +413,38 @@ class ServingEngine:
         # validation first: a malformed feed (ValueError) is a caller bug,
         # not a submitted request — it never enters the accounting
         req = self._build_request(feed, priority, deadline_s)
+        # admission runs as a child span of the request root, so a typed
+        # rejection still ships a complete (if short) trace
+        sub = _trace.start_span("serving.submit", parent=req.span,
+                                priority=req.priority, rows=req.nrows)
         with self._lock:
             self._acct["submitted"] += 1
         try:
             # injected submission failure: typed outcome at the caller
             _faults.fault_point("enqueue")
-        except _faults.InjectedFault:
+        except _faults.InjectedFault as e:
+            sub.end(error=e)
             self._account("rejected_fault")
+            self._finish_request(req, "rejected_fault", e)
             raise
         now = time.monotonic()
         with self._lock:
             if not self._running:
                 self._acct["rejected_stopped"] += 1
                 self._record_outcome("rejected_stopped")
-                raise EngineStopped("serving: engine not running")
-            self._admit_locked(req, now)   # raises Overloaded on shed
+                err = EngineStopped("serving: engine not running")
+                sub.end(error=err)
+                self._finish_request(req, "rejected_stopped", err)
+                raise err
+            try:
+                self._admit_locked(req, now)   # raises Overloaded on shed
+            except Overloaded as e:
+                sub.end(error=e)
+                self._finish_request(req, "shed", e)
+                raise
+            sub.end()
+            _trace.start_span("serving.enqueue", parent=req.span,
+                              queue_depth=len(self._queue)).end()
             self._queue.append(req)
             self._gauge_depth_locked()
             self._work.notify()
@@ -446,9 +480,16 @@ class ServingEngine:
         seq = next(ServingEngine._seq)
         dl = Deadline(budget, what=f"serving request #{seq}") \
             if budget and budget > 0 else None
-        return _Request(seq=seq, feed=vals, nrows=nrows, sig=sig,
-                        priority=int(priority), deadline=dl,
-                        submitted=time.monotonic(), future=ServingFuture())
+        req = _Request(seq=seq, feed=vals, nrows=nrows, sig=sig,
+                       priority=int(priority), deadline=dl,
+                       submitted=time.monotonic(), future=ServingFuture())
+        # one trace per request, minted at submit: the root span stays
+        # open across the queue + the dispatch thread and is settled with
+        # the typed terminal outcome (exactly once, like the accounting)
+        req.span = _trace.root_span("serving.request", seq=seq,
+                                    rows=nrows, priority=int(priority))
+        req.future.trace_id = req.span.trace_id
+        return req
 
     def _admit_locked(self, req: _Request, now: float) -> None:
         """Admission control under ``_lock``: raises typed Overloaded on
@@ -693,13 +734,31 @@ class ServingEngine:
                     dispatched=True)
             self._gauge_open_buckets()
             return
+        # one batch span (its own trace) linking the member request
+        # traces; each request gets a 'serving.dispatch' child under ITS
+        # root carrying the batch ids — submit-thread -> dispatch-thread
+        # parentage without N-parent spans
+        label = self._bucket_label(bucket)
+        batch_span = _trace.NOOP_SPAN
+        if _trace.enabled():
+            batch_span = _trace.root_span(
+                "serving.batch", bucket=label, rows=rows, padded=padded,
+                requests=len(batch),
+                request_traces=",".join(r.span.trace_id for r in batch))
+            for r in batch:
+                r.dispatch_span = _trace.start_span(
+                    "serving.dispatch", parent=r.span, bucket=label,
+                    batch_trace=batch_span.trace_id,
+                    batch_span=batch_span.span_id)
         try:
             _faults.fault_point("batch_dispatch")
             feed = self._pad_feed(batch, rows, padded)
             t0 = time.perf_counter()
-            outs = self._exe.run(self._program, feed=feed,
-                                 fetch_list=self._fetch_names,
-                                 scope=self._scope)
+            # executor/compile/retry spans nest under the batch span
+            with _trace.attach(batch_span):
+                outs = self._exe.run(self._program, feed=feed,
+                                     fetch_list=self._fetch_names,
+                                     scope=self._scope)
             batch_s = time.perf_counter() - t0
         except Exception as e:   # typed per-batch isolation; engine lives
             br.record_failure()
@@ -713,6 +772,8 @@ class ServingEngine:
                 "serving: batch of %d request(s) on bucket %s failed "
                 "(%s: %s) — failing those requests, engine continues",
                 len(batch), self._bucket_label(bucket), type(e).__name__, e)
+            batch_span.set_attribute("outcome", "failed")
+            batch_span.end(error=e)
             for r in batch:
                 # one instance per future: concurrent result() raises
                 # would otherwise interleave __traceback__ on a shared
@@ -723,9 +784,21 @@ class ServingEngine:
                     f"{type(e).__name__}: {e}")
                 err.__cause__ = e
                 self._settle_error(r, "failed", err, dispatched=True)
+            # flight recorder: the incident ships with the failed
+            # requests' full span chains (settled above, so the terminal
+            # outcomes are already in the ring)
+            _trace.record_incident(
+                "batch_failed", error=e,
+                context=batch[0].span if batch else None,
+                detail=f"bucket {self._bucket_label(bucket)}, "
+                       f"{len(batch)} request(s)")
             return
         br.record_success()
         self._gauge_open_buckets()
+        batch_span.set_attribute("outcome", "ok")
+        batch_span.end()
+        _monitor.observe_serving_cost(self._program, padded, batch_s,
+                                      label)
         if _monitor.enabled():
             _monitor.counter("serving_batches_total",
                              "dispatched batches by result").labels(
@@ -769,6 +842,7 @@ class ServingEngine:
                 self._acct["completed"] += 1
                 self._dispatched -= 1
             self._record_outcome("completed")
+            self._finish_request(r, "completed")
             if _monitor.enabled():
                 _monitor.histogram(
                     "serving_request_latency_seconds",
@@ -802,6 +876,27 @@ class ServingEngine:
                 else parts[0]
         return feed
 
+    def _finish_request(self, r: _Request, outcome: str,
+                        err: Optional[BaseException] = None) -> None:
+        """Terminal-outcome bookkeeping shared by every settle path:
+        close the dispatch child (if one is open) and the request root
+        span with the typed outcome, stamp the trace id onto the error
+        and the accounting's recent-outcomes ring. Idempotent on the
+        span side (``Span.end`` closes once)."""
+        if err is not None and isinstance(err, (ServingError,
+                                                DeadlineExceeded)):
+            err.trace_id = r.span.trace_id
+        if r.dispatch_span:
+            r.dispatch_span.end(error=err)
+        if r.span:
+            r.span.set_attribute("outcome", outcome)
+            r.span.end(status="ok" if err is None else "error", error=err)
+        # bounded deque append is GIL-atomic; callers may or may not hold
+        # the engine lock
+        self._recent_outcomes.append(
+            {"seq": r.seq, "outcome": outcome,
+             "trace_id": r.span.trace_id})
+
     def _settle_error(self, r: _Request, key: str, err: BaseException,
                       locked: bool = False, dispatched: bool = False) -> None:
         """``dispatched``: the request had been taken off the queue (its
@@ -816,6 +911,7 @@ class ServingEngine:
                 if dispatched:
                     self._dispatched -= 1
         self._record_outcome(key)
+        self._finish_request(r, key, err)
         r.future._settle(error=err)
 
     def _account(self, key: str) -> None:
@@ -890,6 +986,9 @@ class ServingEngine:
                        if k not in ("submitted", "pending"))
         acct["accounted"] = terminal + acct["pending"]
         acct["exact"] = acct["accounted"] == acct["submitted"]
+        # the last N terminal outcomes with their trace ids: a failed
+        # gate leg names the exact requests (FLAGS_trace off => ids "")
+        acct["recent_outcomes"] = list(self._recent_outcomes)
         return acct
 
     def health(self) -> dict:

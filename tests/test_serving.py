@@ -81,6 +81,10 @@ def _wait_queue_empty(eng, timeout=10.0):
 def test_requests_coalesce_into_one_padded_bucket():
     """Three 1-row requests inside one batch window dispatch as ONE batch
     padded to the 4-bucket, and each caller gets exactly its own rows."""
+    # absolute assertion on the occupancy histogram's max below: clear
+    # the process-global registry so another test's engines (any order)
+    # cannot leak a 1.0-occupancy observation in
+    monitor.reset()
     eng = _engine(max_batch=4, batch_window_s=0.5)
     eng.warm_up()
     before = monitor.metric_value("serving_batches_total", 0.0, result="ok")
@@ -365,10 +369,15 @@ def test_no_faults_zero_sheds_zero_rejections():
         outs = [f.result(timeout=60) for f in futs]
     assert len(outs) == 20
     acct = eng.accounting()
+    recent = acct.pop("recent_outcomes")
     assert acct == {"submitted": 20, "completed": 20, "failed": 0,
                     "shed": 0, "deadline_exceeded": 0, "circuit_open": 0,
                     "rejected_fault": 0, "rejected_stopped": 0,
                     "pending": 0, "accounted": 20, "exact": True}
+    # every terminal outcome is attributable (trace ids are "" with
+    # FLAGS_trace off, but the outcome ring is always kept)
+    assert len(recent) == 20
+    assert all(r["outcome"] == "completed" for r in recent)
     assert eng.health()["open_buckets"] == []
     assert not eng.health()["degraded"]
 
