@@ -78,9 +78,13 @@ fi
 echo "== serving load gate (paddle_tpu.serving: under injected overload,"
 echo "   compile faults and one watchdog-diagnosed hang, every submitted"
 echo "   request reaches exactly one terminal outcome; p50/p99 latency"
-echo "   histogram is the artifact)"
-JAX_PLATFORMS=cpu python tools/load_check.py --ci \
-  --json "${CI_ARTIFACT_DIR:-.}/ci_serving_report.json" | tail -8
+echo "   histogram is the artifact. --decode adds the generative legs: a"
+echo "   GPT-tiny multi-thread generation burst with exact accounting,"
+echo "   zero warm recompiles and tokens/s + inter-token p50/p99 in the"
+echo "   artifact, plus a chaos sub-leg killing one in-flight batch —"
+echo "   every affected stream must settle with a typed outcome)"
+JAX_PLATFORMS=cpu python tools/load_check.py --ci --decode \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_serving_report.json" | tail -10
 echo "== serving negative control (shedding disabled: the gate must FAIL)"
 SERVING_NEG_LOG="${CI_ARTIFACT_DIR:-.}/ci_serving_negative.log"
 if JAX_PLATFORMS=cpu python tools/load_check.py --ci \

@@ -2,7 +2,11 @@
 role (xbyak runtime codegen and hand-fused kernels) rebuilt as Mosaic
 kernels. Everything here must also run under `interpret=True` on CPU (minus
 PRNG-dependent paths) so numerics are testable without hardware."""
-from .flash_attention import (flash_attention, flash_attention_with_lse,
-                              supports_shapes)
+from .flash_attention import (classify_shapes, flash_attention,
+                              flash_attention_with_lse, supports_shapes)
+from .decode_attention import (decode_attention_reference,
+                               flash_attention_decode, paged_kv_append)
 
-__all__ = ["flash_attention", "flash_attention_with_lse", "supports_shapes"]
+__all__ = ["flash_attention", "flash_attention_with_lse", "supports_shapes",
+           "classify_shapes", "flash_attention_decode", "paged_kv_append",
+           "decode_attention_reference"]

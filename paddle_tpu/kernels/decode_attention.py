@@ -1,0 +1,185 @@
+"""Decode-step flash attention over a paged/block KV cache (Pallas TPU).
+
+The autoregressive-serving counterpart of ``flash_attention.py``: one query
+token per sequence (q_len == 1) attends against that sequence's KV cache.
+The cache is *paged* — logically ``[BH, S_max, D]`` where
+``S_max = num_pages * page_size`` and the kernel walks it one page
+(``block_k = page_size``) at a time with the same online-softmax recurrence
+as the prefill kernel, masking key positions ``>= length`` per sequence.
+Pages past a sequence's length hold stale/garbage rows by design (they are
+overwritten when the sequence reaches them); the length mask keeps them out
+of the softmax, so cache capacity can be provisioned once and reused across
+requests at different positions.
+
+CODA (PAPERS.md, arXiv 2605.19269) motivates folding the decode-step
+epilogue work into the fused kernels instead of separate ops:
+:func:`flash_attention_decode` therefore also performs the KV APPEND — the
+new token's K/V rows are written into the cache at ``position`` before the
+attention walk, and the updated caches are returned alongside the output so
+the program-IR level sees ONE op that reads and writes the cache at the
+same index (which is what lets ``analysis.liveness.safe_donation_set``
+prove the cache buffer donatable: its last read is not after its last
+write).
+
+Design notes
+- q rides sublane-replicated ``[BH, 8, D]`` (Mosaic needs the
+  second-to-last dim divisible by 8 for f32; a 1-row tile violates that,
+  8 replicated rows don't — see ``flash_attention._rows8``). Row 0 of the
+  output is the real result.
+- per-sequence lengths arrive as scalar-prefetch values so the kernel's
+  mask needs no extra VMEM traffic; ``lengths[bh // num_heads]`` maps the
+  fused B*H grid axis back to its batch row.
+- inference-only: no custom VJP (decode never differentiates).
+- interpret=True runs the same kernel on CPU for tests/CI parity.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import NEG_INF, CompilerParams, _out_sds
+
+__all__ = ["flash_attention_decode", "paged_kv_append",
+           "decode_attention_reference"]
+
+
+def paged_kv_append(cache, new, positions):
+    """Write ``new`` rows into ``cache`` at per-sequence ``positions``.
+
+    cache: [B, ..., S_max, D]; new: [B, ..., L, D]; positions: [B] int —
+    the start row per sequence (the page-aligned case L == page_size is
+    the prefill bulk write; L == 1 is the decode append). XLA lowers the
+    per-sequence ``dynamic_update_slice`` in place when the cache buffer
+    is donated — this is the KV-append path the decode op fuses with the
+    attention walk. Out-of-range starts clamp (XLA semantics), so a
+    retired sequence whose position saturates keeps overwriting the last
+    row instead of corrupting a neighbour.
+    """
+    positions = positions.reshape(positions.shape[0]).astype(jnp.int32)
+
+    def upd(c, n, p):
+        start = (jnp.int32(0),) * (c.ndim - 2) + (p, jnp.int32(0))
+        return jax.lax.dynamic_update_slice(c, n.astype(c.dtype), start)
+
+    return jax.vmap(upd)(cache, new, positions)
+
+
+def decode_attention_reference(q, k_cache, v_cache, lengths, scale):
+    """Primitive oracle: masked softmax attention of one query row per
+    sequence against its cache. q: [BH, 1, D]; caches: [BH, S, D];
+    lengths: [BH] (already expanded per head). Matches the kernel
+    semantics exactly; also the op's off-TPU lowering."""
+    prec = "highest" if q.dtype == jnp.float32 else "default"
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32), precision=prec) * scale
+    k_pos = jnp.arange(k_cache.shape[1])[None, None, :]
+    s = jnp.where(k_pos < lengths[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bqk,bkd->bqd", p, v_cache.astype(jnp.float32),
+                   precision=prec)
+    return o.astype(q.dtype)
+
+
+def _decode_kernel(scale, num_heads, scal_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_scr, l_scr, acc):
+    bh, ik = pl.program_id(0), pl.program_id(1)
+    num_k = pl.num_programs(1)
+    block_k = k_ref.shape[1]
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc[:] = jnp.zeros_like(acc)
+
+    length = scal_ref[bh // num_heads]
+    q = q_ref[0]                                    # [8, D] (replicated)
+    k = k_ref[0]                                    # [block_k, D]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(k_pos < length, s, NEG_INF)       # page-level length mask
+
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alive = m_new > NEG_INF * 0.5
+    m_safe = jnp.where(alive, m_new, 0.0)
+    corr = jnp.exp(m_prev - m_safe)
+    p = jnp.exp(s - m_safe)
+    l_new = corr * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    acc[:] = acc[:] * corr + pv
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == num_k - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def flash_attention_decode(q, k_cache, v_cache, lengths, *,
+                           scale=None, num_heads: int = 1,
+                           page_size: int = 128,
+                           interpret: bool = False):
+    """One decode step: q [BH, 1, D] against paged caches [BH, S_max, D].
+
+    ``lengths`` is per-BATCH ([B] int, B = BH // num_heads): the number of
+    valid key rows per sequence (positions >= length are masked out).
+    ``page_size`` is the kernel's k-block — the cache page granularity;
+    ``S_max`` must divide into whole pages
+    (``flash_attention.classify_shapes`` refuses otherwise). Returns
+    o [BH, 1, D]. Inference-only (no VJP).
+    """
+    BH, Sq, D = q.shape
+    Sk = k_cache.shape[1]
+    if Sq != 1:
+        raise ValueError(
+            f"flash_attention_decode is the q_len=1 path, got q_len={Sq}; "
+            f"use flash_attention for prefill/full-sequence shapes")
+    bk = min(page_size, Sk)
+    if Sk % bk:
+        raise ValueError(
+            f"decode cache length S_max={Sk} must divide into whole pages "
+            f"of page_size={bk}")
+    scale = float(scale if scale is not None else D ** -0.5)
+    lengths = jnp.asarray(lengths).reshape(-1).astype(jnp.int32)
+    if lengths.shape[0] * num_heads != BH:
+        raise ValueError(
+            f"lengths has {lengths.shape[0]} rows but q has BH={BH} with "
+            f"num_heads={num_heads} (expected {BH // num_heads})")
+    # sublane-replicate the single query row: [BH, 1, D] -> [BH, 8, D]
+    q8 = jnp.broadcast_to(q, (BH, 8, D))
+    nk = Sk // bk
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(BH, nk),
+        in_specs=[
+            pl.BlockSpec((1, 8, D), lambda bh, ik, s: (bh, 0, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ik, s: (bh, ik, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ik, s: (bh, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 8, D), lambda bh, ik, s: (bh, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((8, 128), jnp.float32),     # running max
+            pltpu.VMEM((8, 128), jnp.float32),     # running denom
+            pltpu.VMEM((8, D), jnp.float32),       # numerator acc
+        ],
+    )
+    (o8,) = pl.pallas_call(
+        functools.partial(_decode_kernel, scale, int(num_heads)),
+        grid_spec=grid_spec,
+        out_shape=[_out_sds((BH, 8, D), q.dtype, q, k_cache, v_cache)],
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(lengths, q8, k_cache, v_cache)
+    return o8[:, :1, :]

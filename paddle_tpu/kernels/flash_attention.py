@@ -45,9 +45,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "flash_attention_with_lse", "supports_shapes"]
+__all__ = ["flash_attention", "flash_attention_with_lse", "supports_shapes",
+           "classify_shapes"]
 
 NEG_INF = -1e30          # finite sentinel: (-inf) - (-inf) would NaN
+
+# jax renamed TPUCompilerParams -> CompilerParams around 0.5; accept both so
+# the kernels load on either side of the rename
+CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
 # odd mixing constants for per-block reseeding, pre-wrapped to int32 range
 # (jax int32 multiply wraps, which is exactly the mixing we want)
 _SEED_MIX_BH = -1640532047   # int32(0x9E3779B1)
@@ -72,11 +78,50 @@ class _Cfg:
     precision: str
 
 
+def classify_shapes(sq: int, sk: int, block_q: int = 128,
+                    block_k: int = 128):
+    """Classify an attention shape for the kernel layer.
+
+    Returns ``(kind, reason)`` where ``kind`` is one of:
+
+    * ``'prefill'`` — full-sequence shapes the blockwise kernel tiles
+      (both sequence lengths divide into whole blocks);
+    * ``'decode'`` — the q_len == 1 autoregressive step against a
+      block/page-tiled KV cache (``decode_attention.flash_attention_decode``;
+      ``block_k`` is the page size and the cache must hold whole pages);
+    * ``'unsupported'`` — no kernel tiling fits; ``reason`` says exactly
+      which divisibility failed so callers can refuse loudly instead of
+      falling through to the dense path silently.
+    """
+    if sq == 1:
+        bk = min(block_k, sk)
+        if sk % bk == 0:
+            return ("decode",
+                    f"q_len=1 against a block-KV cache of {sk // bk} "
+                    f"page(s) x {bk}")
+        return ("unsupported",
+                f"decode shape (q_len=1) but the KV cache length sk={sk} "
+                f"does not divide into whole pages of page_size={bk}; pad "
+                f"the cache capacity to a multiple of the page size")
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    bad = []
+    if sq % bq:
+        bad.append(f"sq={sq} % block_q={bq}")
+    if sk % bk:
+        bad.append(f"sk={sk} % block_k={bk}")
+    if bad:
+        return ("unsupported",
+                f"sequence lengths must divide into whole kernel blocks: "
+                f"{', '.join(bad)} != 0 (pad the sequence or pick block "
+                f"sizes that divide it)")
+    return ("prefill", f"{sq // bq} q-block(s) x {sk // bk} k-block(s)")
+
+
 def supports_shapes(sq: int, sk: int, block_q: int = 128,
                     block_k: int = 128) -> bool:
-    """Kernel requires sequence lengths divisible by the block sizes."""
-    bq, bk = min(block_q, sq), min(block_k, sk)
-    return sq % bq == 0 and sk % bk == 0
+    """Whether a kernel tiling (prefill or decode) covers these shapes.
+    ``classify_shapes`` carries the which-and-why."""
+    return classify_shapes(sq, sk, block_q, block_k)[0] != "unsupported"
 
 
 def _out_sds(shape, dtype, *like):
@@ -217,7 +262,7 @@ def _fwd(cfg: _Cfg, q, k, v, bias, scalars):
             _out_sds((BH, Sq, D), q.dtype, q, k, v),
             _out_sds((BH, 8, Sq), jnp.float32, q, k, v),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=cfg.interpret,
     )(scalars, *args)
@@ -355,7 +400,7 @@ def _bwd(cfg: _Cfg, q, k, v, bias, scalars, do, lse, delta):
             scratch_shapes=[pltpu.VMEM((cfg.block_q, D), jnp.float32)],
         ),
         out_shape=[_out_sds((BH, Sq, D), q.dtype, q, k, v, do)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=cfg.interpret,
     )(scalars, *args)[0]
@@ -385,7 +430,7 @@ def _bwd(cfg: _Cfg, q, k, v, bias, scalars, do, lse, delta):
         ),
         out_shape=[_out_sds((BH, Sk, D), k.dtype, q, k, v, do),
                    _out_sds((BH, Sk, D), v.dtype, q, k, v, do)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=cfg.interpret,
     )(scalars, *args)

@@ -35,6 +35,8 @@ __all__ = [
     "sampled_softmax_with_cross_entropy", "py_func", "resize_trilinear",
     "lstm_unit", "autoincreased_step_counter", "adaptive_pool3d",
     "beam_search", "beam_search_decode", "filter_by_instag",
+    "fused_decode_attention", "kv_cache_append", "sequence_gather",
+    "sample_token",
 ]
 
 
@@ -916,3 +918,65 @@ def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True):
         "filter_by_instag selects variable-size row subsets at runtime — "
         "dynamic shapes XLA cannot compile. Filter in the data pipeline "
         "(reader decorators) or mask rows with sequence_mask instead.")
+
+
+def fused_decode_attention(q, k_new, v_new, cache_k, cache_v, positions,
+                           scale=0.0, page_size=128, name=None):
+    """One autoregressive decode step with the KV append fused in
+    (ops/generation.py). q/k_new/v_new: [B, H, 1, D]; cache_k/cache_v:
+    persistable paged caches [B, H, S_max, D]; positions: [B, 1] int —
+    each sequence's length before this token. The updated caches are
+    written BACK INTO the cache vars (the single read+write op shape the
+    donation proof needs), and the attended context [B, H, 1, D] is
+    returned. scale=0.0 means 1/sqrt(D)."""
+    helper = LayerHelper("fused_decode_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(
+        "fused_decode_attention",
+        inputs={"Q": q, "KNew": k_new, "VNew": v_new,
+                "CacheK": cache_k, "CacheV": cache_v,
+                "Positions": positions},
+        outputs={"Out": out, "CacheKOut": cache_k, "CacheVOut": cache_v},
+        attrs={"scale": float(scale), "page_size": int(page_size)})
+    return out
+
+
+def kv_cache_append(cache, new, positions, slot_mask=None, name=None):
+    """Bulk KV write into a paged cache var (ops/generation.py): ``new``
+    [B, H, L, D] lands at per-sequence ``positions`` [B, 1]; with
+    ``slot_mask`` [B, 1] only masked sequences' rows change (the
+    continuous-batching refill). Writes in place into ``cache`` (returns
+    the same var)."""
+    helper = LayerHelper("kv_cache_append", name=name)
+    inputs = {"Cache": cache, "New": new, "Positions": positions}
+    if slot_mask is not None:
+        inputs["SlotMask"] = slot_mask
+    helper.append_op("kv_cache_append", inputs=inputs,
+                     outputs={"Out": cache})
+    return cache
+
+
+def sequence_gather(x, index, name=None):
+    """Out[b] = x[b, index[b]] — gather one position per sequence along
+    axis 1 (x: [B, S, ...], index: [B, 1] int, clamped into range)."""
+    helper = LayerHelper("sequence_gather", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_gather", inputs={"X": x, "Index": index},
+                     outputs={"Out": out})
+    return out
+
+
+def sample_token(logits, strategy="greedy", temperature=1.0, top_k=0,
+                 name=None):
+    """Next-token selection from [B, V] logits -> [B, 1] int64
+    (ops/generation.py): 'greedy' argmax, or seeded 'sample' with
+    temperature and optional top-k truncation — deterministic for a fixed
+    program.random_seed."""
+    helper = LayerHelper("sample_token", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("sample_token", inputs={"Logits": logits},
+                     outputs={"Out": out},
+                     attrs={"strategy": strategy,
+                            "temperature": float(temperature),
+                            "top_k": int(top_k)})
+    return out

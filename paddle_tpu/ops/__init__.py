@@ -9,6 +9,7 @@ from . import amp_ops  # noqa: F401
 from . import recompute  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
+from . import generation  # noqa: F401
 from . import detection  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import fused_attention  # noqa: F401

@@ -26,16 +26,17 @@ from .. import flags
 
 def _route(sq: int, sk: int, dropout: float) -> str:
     """'pallas' | 'pallas-interpret' | 'primitive'."""
-    from ..kernels import supports_shapes
+    from ..kernels import classify_shapes
 
     mode = flags.flag("use_flash_attention")
     if mode == "never":
         return "primitive"
-    if not supports_shapes(sq, sk):
+    kind, reason = classify_shapes(sq, sk)
+    if kind == "unsupported":
         if mode == "always":
             raise ValueError(
                 f"FLAGS_use_flash_attention=always but seq lengths "
-                f"({sq}, {sk}) are not divisible by the kernel blocks")
+                f"({sq}, {sk}) have no kernel tiling: {reason}")
         return "primitive"
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:

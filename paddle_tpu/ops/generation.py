@@ -1,0 +1,188 @@
+"""Generative-inference ops: fused decode attention over a paged KV cache,
+bulk KV writes, last-position gathers and in-program token sampling.
+
+These are the decode-step building blocks of ``models/gpt.py`` and the
+serving layer's prefill/decode split (``serving.generate``). Two design
+rules shape them:
+
+* **The KV append is fused into the decode attention op** (CODA, PAPERS.md
+  arXiv 2605.19269: fold decode-step epilogue work into the fused kernels):
+  ``fused_decode_attention`` reads AND writes the cache vars at one op
+  index, so ``analysis.liveness.safe_donation_set`` proves the cache
+  buffers donatable — the executor updates the multi-megabyte cache in
+  place instead of copying it every token, including through
+  ``run_chained``'s scan carry. A separate append-then-attend op pair
+  would read the cache after its write and the liveness proof would
+  (correctly) refuse the donation.
+* **Sampling runs in-program** (``sample_token``): the sampled token is a
+  program state write, so a whole decode chunk runs as ONE ``run_chained``
+  dispatch with no host round-trip per token; seeded through the op-uid
+  PRNG discipline, CI runs are deterministic.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .common import IOSpec, register_op, x
+from .. import flags
+from ..core.types import jnp_dtype
+
+
+def _route_decode(s_max: int, page_size: int) -> str:
+    """'pallas' | 'pallas-interpret' | 'primitive' for a decode shape."""
+    from ..kernels import classify_shapes
+
+    mode = flags.flag("use_flash_attention")
+    if mode == "never":
+        return "primitive"
+    kind, reason = classify_shapes(1, s_max, block_k=page_size)
+    if kind != "decode":
+        if mode == "always":
+            raise ValueError(
+                f"FLAGS_use_flash_attention=always but the decode shape "
+                f"has no kernel tiling: {reason}")
+        return "primitive"
+    if jax.default_backend() == "tpu":
+        return "pallas"
+    return "pallas-interpret" if mode == "always" else "primitive"
+
+
+@register_op(
+    "fused_decode_attention",
+    inputs=[IOSpec("Q"), IOSpec("KNew"), IOSpec("VNew"),
+            IOSpec("CacheK"), IOSpec("CacheV"),
+            IOSpec("Positions", no_grad=True)],
+    outputs=["Out", "CacheKOut", "CacheVOut"],
+    attrs={"scale": 0.0, "page_size": 128},
+    grad=None)
+def _fused_decode_attention(ctx, ins, attrs):
+    """One autoregressive decode step, epilogue fused:
+
+    1. append this step's K/V rows (``KNew``/``VNew`` [B, H, 1, D]) into
+       the paged caches ([B, H, S_max, D]) at per-sequence ``Positions``
+       ([B, 1] int — the sequence length BEFORE this token);
+    2. attend the single query row against the updated cache with a
+       per-sequence length mask (valid keys: positions < pos + 1).
+
+    ``CacheKOut``/``CacheVOut`` are the updated caches — program builders
+    point them back at the cache vars, making this the one op that reads
+    and writes them (the donation-proof shape, see module docstring).
+    Retired sequences whose position saturates past S_max - 1 clamp onto
+    the last row (XLA dynamic_update_slice semantics) and their output is
+    garbage by design — the serving layer discards it.
+    """
+    from ..kernels import (decode_attention_reference, flash_attention_decode,
+                           paged_kv_append)
+
+    q, kn, vn = x(ins, "Q"), x(ins, "KNew"), x(ins, "VNew")
+    ck, cv = x(ins, "CacheK"), x(ins, "CacheV")
+    pos = x(ins, "Positions")
+    B, H, q_len, D = q.shape
+    if q_len != 1:
+        raise ValueError(
+            f"fused_decode_attention: q_len must be 1 (the decode step), "
+            f"got {q_len}; use fused_multihead_attention for prefill")
+    S = ck.shape[2]
+    page = int(attrs.get("page_size") or 128)
+    scale = attrs["scale"] or float(D) ** -0.5
+    pos_b = pos.reshape(B).astype(jnp.int32)
+    ck2 = paged_kv_append(ck, kn, pos_b)
+    cv2 = paged_kv_append(cv, vn, pos_b)
+    lengths = jnp.minimum(pos_b + 1, S)
+
+    q3 = q.reshape(B * H, 1, D)
+    k3 = ck2.reshape(B * H, S, D)
+    v3 = cv2.reshape(B * H, S, D)
+    route = _route_decode(S, page)
+    if route == "primitive":
+        o = decode_attention_reference(q3, k3, v3,
+                                       jnp.repeat(lengths, H, axis=0), scale)
+    else:
+        o = flash_attention_decode(
+            q3, k3, v3, lengths, scale=scale, num_heads=H,
+            page_size=page, interpret=(route == "pallas-interpret"))
+    return {"Out": [o.reshape(B, H, 1, D)],
+            "CacheKOut": [ck2], "CacheVOut": [cv2]}
+
+
+@register_op(
+    "kv_cache_append",
+    inputs=[IOSpec("Cache"), IOSpec("New"),
+            IOSpec("Positions", no_grad=True),
+            IOSpec("SlotMask", optional=True, no_grad=True)],
+    outputs=["Out"],
+    attrs={},
+    grad=None)
+def _kv_cache_append(ctx, ins, attrs):
+    """Bulk KV write: place ``New`` [B, H, L, D] rows into ``Cache``
+    [B, H, S_max, D] starting at per-sequence ``Positions`` [B, 1] (the
+    prefill path writes a whole prompt, L = prompt bucket, at position 0).
+    ``SlotMask`` [B, 1] (optional) keeps un-masked sequences' cache rows
+    untouched — the continuous-batching refill writes only the slots being
+    prefilled while their neighbours keep decoding. Builders point ``Out``
+    back at the cache var: the op reads and writes it at one index, so the
+    buffer donates (liveness-proven in-place update)."""
+    from ..kernels import paged_kv_append
+
+    cache, new, pos = x(ins, "Cache"), x(ins, "New"), x(ins, "Positions")
+    mask = x(ins, "SlotMask")
+    B = cache.shape[0]
+    upd = paged_kv_append(cache, new, pos.reshape(B))
+    if mask is not None:
+        m = (mask.reshape(B) > 0).reshape((B,) + (1,) * (cache.ndim - 1))
+        upd = jnp.where(m, upd, cache)
+    return {"Out": [upd]}
+
+
+@register_op(
+    "sequence_gather",
+    inputs=[IOSpec("X"), IOSpec("Index", no_grad=True)],
+    outputs=["Out"])
+def _sequence_gather(ctx, ins, attrs):
+    """Per-sequence gather along axis 1: X [B, S, ...], Index [B, 1] ->
+    Out [B, ...] = X[b, Index[b]]. The prefill path uses it to pull the
+    last real prompt position's hidden state out of a padded batch
+    (indices clamp into [0, S-1])."""
+    xv, idx = x(ins, "X"), x(ins, "Index")
+    B = xv.shape[0]
+    i = jnp.clip(idx.reshape(B).astype(jnp.int32), 0, xv.shape[1] - 1)
+    i = i.reshape((B, 1) + (1,) * (xv.ndim - 2))
+    taken = jnp.take_along_axis(xv, jnp.broadcast_to(
+        i, (B, 1) + xv.shape[2:]), axis=1)
+    return {"Out": [taken[:, 0]]}
+
+
+@register_op(
+    "sample_token",
+    inputs=[IOSpec("Logits", no_grad=True)],
+    outputs=["Out"],
+    attrs={"strategy": "greedy", "temperature": 1.0, "top_k": 0},
+    needs_rng=True,
+    grad=None)
+def _sample_token(ctx, ins, attrs):
+    """Next-token selection from ``Logits`` [B, V] -> ``Out`` [B, 1] int64.
+
+    ``strategy='greedy'`` is pure argmax (deterministic, the CI default);
+    ``'sample'`` draws from softmax(logits / temperature), optionally
+    truncated to the ``top_k`` highest-probability tokens. The PRNG key is
+    the executor's op-uid-folded key, so a fixed ``program.random_seed``
+    reproduces the same token sequence run over run."""
+    logits = x(ins, "Logits").astype(jnp.float32)
+    strategy = str(attrs.get("strategy", "greedy"))
+    if strategy == "greedy":
+        tok = jnp.argmax(logits, axis=-1)
+    elif strategy == "sample":
+        temp = max(float(attrs.get("temperature", 1.0)), 1e-6)
+        scaled = logits / temp
+        k = int(attrs.get("top_k", 0))
+        if k > 0:
+            k = min(k, scaled.shape[-1])
+            thresh = jax.lax.top_k(scaled, k)[0][:, -1:]
+            scaled = jnp.where(scaled >= thresh, scaled, -1e30)
+        tok = jax.random.categorical(ctx.rng(), scaled, axis=-1)
+    else:
+        raise ValueError(
+            f"sample_token: unknown strategy '{strategy}' "
+            f"(expected 'greedy' or 'sample')")
+    return {"Out": [tok.astype(jnp_dtype("int64"))[:, None]]}

@@ -32,10 +32,11 @@ from .breaker import CircuitBreaker
 from .engine import (BatchFailed, CircuitOpen, EngineStopped, Overloaded,
                      ServingConfig, ServingEngine, ServingError,
                      ServingFuture)
+from .generate import GenerationConfig, GenerativeEngine
 
 __all__ = [
     "ServingEngine", "ServingConfig", "ServingFuture", "CircuitBreaker",
-    "Deadline",
+    "Deadline", "GenerativeEngine", "GenerationConfig",
     # typed terminal outcomes
     "ServingError", "Overloaded", "CircuitOpen", "BatchFailed",
     "EngineStopped", "DeadlineExceeded",

@@ -191,7 +191,15 @@ class ServingFuture:
     """One request's pending terminal outcome. Settled exactly once by
     the engine; a second settle attempt is an engine bug and raises.
     ``trace_id`` (non-empty under ``FLAGS_trace``) names the request's
-    trace — the handle for pulling its span chain from the collector."""
+    trace — the handle for pulling its span chain from the collector.
+
+    Generative requests additionally STREAM: the engine emits tokens as
+    decode chunks finish (``tokens()``/``stream()``). Intermediate tokens
+    are *partial results*, not outcomes — the exactly-one-terminal-outcome
+    accounting invariant is untouched: however many tokens streamed, the
+    request still settles exactly once (a completed result carrying the
+    full token array, or a typed error such as a mid-stream
+    ``DeadlineExceeded``, after which no further token can be emitted)."""
 
     trace_id = ""
 
@@ -200,9 +208,59 @@ class ServingFuture:
         self._lock = threading.Lock()
         self._result: Optional[List[np.ndarray]] = None
         self._error: Optional[BaseException] = None
+        # streamed partial results (generative requests): guarded by
+        # _lock, waiters ride the shared-lock condition
+        self._tokens: List[Any] = []
+        self._stream_cond = threading.Condition(self._lock)
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    # -- streaming (generative requests) ---------------------------------
+    def tokens(self) -> List[Any]:
+        """Snapshot of the tokens streamed so far (partial results; also
+        the salvage after a mid-stream typed failure)."""
+        with self._lock:
+            return list(self._tokens)
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield tokens as the engine emits them. Ends with normal
+        iterator exhaustion on a completed request; raises the typed
+        terminal error after yielding every token emitted before it (a
+        mid-stream ``DeadlineExceeded`` surfaces here, with the partial
+        tokens already delivered). ``timeout`` bounds each wait for the
+        NEXT token — expiry raises ``TimeoutError`` without cancelling
+        the request."""
+        i = 0
+        while True:
+            with self._stream_cond:
+                while i >= len(self._tokens) and not self._event.is_set():
+                    if not self._stream_cond.wait(timeout):
+                        raise TimeoutError(
+                            "serving: stream() wait for the next token "
+                            "timed out; the request is still pending "
+                            "(not cancelled)")
+                batch = self._tokens[i:]
+                settled = self._event.is_set()
+            for t in batch:
+                yield t
+            i += len(batch)
+            if settled and i >= len(self.tokens()):
+                if self._error is not None:
+                    raise self._error
+                return
+
+    def _emit_tokens(self, toks: Sequence[Any]) -> None:
+        """Engine side: append partial results and wake stream waiters.
+        Emitting after the terminal outcome is an engine bug — the
+        settle is the LAST word on a request."""
+        with self._stream_cond:
+            if self._event.is_set():
+                raise RuntimeError(
+                    "serving internal error: token emitted after the "
+                    "request's terminal outcome")
+            self._tokens.extend(toks)
+            self._stream_cond.notify_all()
 
     def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
         """The fetch arrays (rows of this request only), or raises the
@@ -229,6 +287,8 @@ class ServingFuture:
                     "one request (exactly-once accounting violated)")
             self._result, self._error = result, error
             self._event.set()
+            # stream() waiters must observe the terminal outcome too
+            self._stream_cond.notify_all()
 
 
 @dataclasses.dataclass
@@ -417,6 +477,14 @@ class ServingEngine:
         # rejection still ships a complete (if short) trace
         sub = _trace.start_span("serving.submit", parent=req.span,
                                 priority=req.priority, rows=req.nrows)
+        return self._admit_and_enqueue(req, sub)
+
+    def _admit_and_enqueue(self, req: _Request, sub) -> ServingFuture:
+        """The admission sequence shared by every submit flavour
+        (request/response and generative): accounting, the enqueue fault
+        point, the stopped check, admission control, the enqueue span and
+        the dispatcher wake. Every rejection is a typed terminal
+        outcome."""
         with self._lock:
             self._acct["submitted"] += 1
         try:

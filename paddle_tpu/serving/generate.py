@@ -1,0 +1,583 @@
+"""Generative serving: prefill/decode split scheduling over a GPT model.
+
+``GenerativeEngine`` extends :class:`~paddle_tpu.serving.engine.ServingEngine`
+with the autoregressive workload class (ROADMAP item 1): requests are token
+prompts, responses are token streams. The engine owns a fixed set of
+**batch slots** — one shared KV-page bucket per slot batch — and splits
+work into the two phases of ``models/gpt.py``:
+
+* **prefill** — queued requests are admitted into free slots at decode-
+  chunk boundaries and prefilled as one slot-masked batch per prompt
+  bucket (padded to the bucket length). The prefill writes the slot's KV
+  pages, merges the slot's generation state, and produces the request's
+  FIRST token — streamed immediately.
+* **decode** — every active slot advances ``decode_chunk`` tokens per
+  dispatch as ONE ``run_chained`` scan (the paged KV caches ride the scan
+  carry, donation-proven, updated in place; sampling runs in-program so
+  no host round-trip separates tokens). Sequences sit at *different
+  positions* inside one batch — position is data, not shape, so every
+  chunk reuses one executable per (phase, bucket). The
+  ``serving_decode_recompiles_total`` guard turns any violation (a shape
+  leaking into a cache key as KV grows) into a counted, logged event and
+  a CI-gated metric.
+
+Contract (inherited, unchanged): every submitted request reaches EXACTLY
+ONE terminal outcome. Streamed tokens are partial results, not outcomes —
+a request that expires mid-stream settles ``DeadlineExceeded`` (typed)
+with its partial tokens still readable from the future. Deadlines apply
+per token: they are re-checked before every prefill and after every
+decode chunk, so an expired stream stops within ``decode_chunk`` tokens.
+
+Failure isolation: an injected ``batch_dispatch`` fault (the chaos gate's
+kill-one-batch leg) fails exactly the streams in that dispatch, typed
+``BatchFailed``, and the engine keeps serving. A REAL executor failure
+mid-dispatch may have consumed donated state buffers, so it additionally
+fails every resident stream typed and resets the generation state —
+never a silent wrong-token continuation.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import monitor as _monitor
+from .. import trace as _trace
+from ..core.types import np_dtype
+from ..resilience import faults as _faults
+from ..resilience.deadline import Deadline, DeadlineExceeded
+from .engine import (BatchFailed, EngineStopped, ServingConfig,
+                     ServingEngine, ServingFuture, _Request)
+
+__all__ = ["GenerationConfig", "GenerativeEngine"]
+
+logger = logging.getLogger("paddle_tpu.serving")
+
+
+@dataclasses.dataclass
+class GenerationConfig:
+    """Generative-scheduling knobs (the serving half; model geometry —
+    slots, pages, buckets — lives on the ``build_gpt_generative`` dict)."""
+
+    decode_chunk: int = 4          # tokens per chained decode dispatch;
+    # also the deadline-enforcement granularity
+    max_new_tokens_default: int = 16
+    eos_id: int = -1               # < 0: no stop token
+
+    def resolve(self) -> "GenerationConfig":
+        if self.decode_chunk < 1:
+            raise ValueError(f"generation: decode_chunk must be >= 1, got "
+                             f"{self.decode_chunk}")
+        if self.max_new_tokens_default < 1:
+            raise ValueError(f"generation: max_new_tokens_default must be "
+                             f">= 1, got {self.max_new_tokens_default}")
+        return self
+
+
+@dataclasses.dataclass
+class _GenRequest(_Request):
+    prompt: np.ndarray = None      # [L] int64
+    bucket: int = 0                # prompt bucket (padded length)
+    max_new: int = 1
+    slot: int = -1                 # assigned batch slot, -1 while queued
+    emitted: int = 0
+    out_tokens: List[int] = dataclasses.field(default_factory=list)
+
+
+class GenerativeEngine(ServingEngine):
+    """See module docstring. ``model`` is a ``build_gpt_generative`` dict;
+    parameters must already be initialized in ``scope`` (run the model's
+    startup program first). Generation state (tokens/positions/KV pages)
+    is planted and reset by the engine itself."""
+
+    def __init__(self, model: dict, scope=None, place=None, executor=None,
+                 config: Optional[ServingConfig] = None,
+                 gen_config: Optional[GenerationConfig] = None):
+        decode = model["decode"]
+        super().__init__(decode["main"], feed_names=[],
+                         fetch_list=[decode["next_token"]],
+                         scope=scope, place=place, executor=executor,
+                         config=config)
+        self._model = model
+        self.gen_config = (gen_config or GenerationConfig()).resolve()
+        self._slots: List[Optional[_GenRequest]] = \
+            [None] * int(model["batch_slots"])
+        self._max_seq = int(model["max_seq"])
+        self._page_size = int(model["page_size"])
+        self._buckets = tuple(model["prompt_buckets"])
+        # recompile guard: (phase, bucket) -> True once its executable
+        # exists; any LATER cache growth on the same key is a recompile
+        self._compiled_buckets: Dict[tuple, bool] = {}
+        self.decode_recompiles = 0
+
+    # -- state lifecycle -------------------------------------------------
+    def reset_generation_state(self) -> None:
+        """Plant zeroed generation state (tokens, positions, KV pages) in
+        the scope. Called at warm-up/start and after a real mid-dispatch
+        failure (consumed donated buffers are never reused)."""
+        for name, (shape, dt) in self._model["state_vars"].items():
+            self._scope.set_var(name, np.zeros(shape, np_dtype(dt)))
+
+    def _ensure_state(self) -> None:
+        for name in self._model["state_vars"]:
+            if self._scope.find_var(name) is None:
+                self.reset_generation_state()
+                return
+
+    def start(self) -> "GenerativeEngine":
+        self._ensure_state()
+        super().start()
+        return self
+
+    def warm_up(self, batch_sizes=None) -> int:
+        """Compile every (phase, bucket) executable before traffic: each
+        prefill bucket with an all-zero slot mask (no slot is touched) and
+        one decode chunk on scratch state. Seeds the recompile guard —
+        after warm-up, steady-state decode must never compile again.
+
+        Unlike the base engine's stateless warm-up, this one RESETS the
+        generation state and dispatches on the caller thread, so it must
+        run before ``start()``: on a running engine it would zero resident
+        streams' caches mid-generation while racing the dispatch thread —
+        refused loudly instead."""
+        with self._lock:
+            if self._running:
+                raise RuntimeError(
+                    "serving: GenerativeEngine.warm_up resets the "
+                    "generation state and cannot run on a started engine "
+                    "(resident streams would silently decode from zeroed "
+                    "caches); call it before start()")
+        self.reset_generation_state()
+        compiled = 0
+        for bucket in self._buckets:
+            net = self._model["prefill"][bucket]
+            feed = self._prefill_feed(bucket, [])
+            self._exe.run(net["main"], feed=feed,
+                          fetch_list=[net["first_token"].name],
+                          scope=self._scope)
+            self._note_compiles("prefill", bucket, net["main"])
+            compiled += 1
+        self._exe.run_chained(self._program, feed={},
+                              fetch_list=self._fetch_names,
+                              steps=self.gen_config.decode_chunk,
+                              scope=self._scope)
+        self._note_compiles("decode", len(self._slots), self._program)
+        compiled += 1
+        self.reset_generation_state()
+        return compiled
+
+    # -- submission ------------------------------------------------------
+    def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
+               priority: int = 0,
+               deadline_s: Optional[float] = None) -> ServingFuture:
+        """Admit one generation request (any thread). ``prompt`` is a 1-D
+        int token array (a ``[1, L]`` row is accepted); the returned
+        future STREAMS tokens (``ServingFuture.stream()``) and settles
+        exactly once with the full token array or a typed error."""
+        req = self._build_gen_request(prompt, max_new_tokens, priority,
+                                      deadline_s)
+        sub = _trace.start_span("serving.submit", parent=req.span,
+                                priority=req.priority,
+                                prompt_len=len(req.prompt))
+        # the base engine's shared admission sequence: accounting, the
+        # enqueue fault point, typed rejections, the dispatcher wake
+        return self._admit_and_enqueue(req, sub)
+
+    def _build_gen_request(self, prompt, max_new_tokens, priority,
+                           deadline_s) -> _GenRequest:
+        prompt = np.asarray(prompt)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError(
+                f"serving: prompt must be a non-empty 1-D token array, "
+                f"got shape {prompt.shape}")
+        prompt = prompt.astype(np.int64)
+        L = int(prompt.shape[0])
+        bucket = next((b for b in self._buckets if b >= L), None)
+        if bucket is None:
+            raise ValueError(
+                f"serving: prompt length {L} exceeds the largest prompt "
+                f"bucket {max(self._buckets)}; split or truncate the "
+                f"prompt")
+        max_new = int(max_new_tokens
+                      if max_new_tokens is not None
+                      else self.gen_config.max_new_tokens_default)
+        if max_new < 1:
+            raise ValueError(f"serving: max_new_tokens must be >= 1, got "
+                             f"{max_new}")
+        if L + max_new > self._max_seq:
+            raise ValueError(
+                f"serving: prompt ({L}) + max_new_tokens ({max_new}) "
+                f"exceeds the KV capacity max_seq {self._max_seq}")
+        budget = self.config.deadline_s if deadline_s is None else deadline_s
+        seq = next(ServingEngine._seq)
+        dl = Deadline(budget, what=f"serving generation #{seq}") \
+            if budget and budget > 0 else None
+        req = _GenRequest(seq=seq, feed={}, nrows=1, sig=("gen", bucket),
+                          priority=int(priority), deadline=dl,
+                          submitted=time.monotonic(), future=ServingFuture(),
+                          prompt=prompt, bucket=bucket, max_new=max_new)
+        req.span = _trace.root_span("serving.request", seq=seq,
+                                    prompt_len=L, max_new=max_new,
+                                    priority=int(priority))
+        req.future.trace_id = req.span.trace_id
+        return req
+
+    # -- scheduler -------------------------------------------------------
+    def _dispatch_forever(self) -> None:
+        self._current_batch = []
+        while True:
+            with self._lock:
+                while (self._running and not self._queue
+                       and not any(r is not None for r in self._slots)):
+                    self._work.wait(timeout=0.05)
+                    self._sweep_expired_locked(time.monotonic())
+                    self._update_pressure_locked(time.monotonic())
+                active = [r for r in self._slots if r is not None]
+                stopping = not self._running and (
+                    not self._drain or (not self._queue and not active))
+                if stopping:
+                    leftovers, self._queue = self._queue, []
+                    self._slots = [None] * len(self._slots)
+                    self._gauge_depth_locked()
+                else:
+                    now = time.monotonic()
+                    self._sweep_expired_locked(now)
+                    self._update_pressure_locked(now)
+                    newcomers = self._refill_locked()
+            if stopping:
+                for r in leftovers + active:
+                    if not r.future.done():
+                        self._settle_error(
+                            r, "rejected_stopped",
+                            EngineStopped("serving: engine stopped without "
+                                          "draining"),
+                            dispatched=(r in active))
+                self._current_batch = []
+                return
+            # the crash guard settles every RESIDENT request, not just the
+            # ones inside one dispatch
+            self._current_batch = [r for r in self._slots if r is not None]
+            if newcomers:
+                self._run_prefill(newcomers)
+                self._current_batch = [r for r in self._slots
+                                       if r is not None]
+            if any(r is not None for r in self._slots):
+                self._run_decode_chunk()
+                self._current_batch = [r for r in self._slots
+                                       if r is not None]
+            self._gauge_kv_occupancy()
+
+    def _refill_locked(self) -> List[_GenRequest]:
+        """Assign queued requests to free slots (FIFO). Runs under
+        ``_lock``; the assigned requests count as dispatched from here on
+        (the accounting's in-flight arm)."""
+        free = [j for j, r in enumerate(self._slots) if r is None]
+        taken: List[_GenRequest] = []
+        while free and self._queue:
+            r = self._queue.pop(0)
+            r.slot = free.pop(0)
+            self._slots[r.slot] = r
+            self._dispatched += 1
+            taken.append(r)
+        if taken:
+            self._gauge_depth_locked()
+        return taken
+
+    # -- prefill ---------------------------------------------------------
+    def _prefill_feed(self, bucket: int,
+                      reqs: Sequence[_GenRequest]) -> dict:
+        B = len(self._slots)
+        feed = {
+            "prompt_ids": np.zeros((B, bucket), np.int64),
+            "prompt_pos": np.tile(np.arange(bucket, dtype=np.int64),
+                                  (B, 1)),
+            "prompt_mask": np.zeros((B, bucket), np.float32),
+            "prompt_len": np.ones((B, 1), np.int64),
+            "slot_mask": np.zeros((B, 1), np.float32),
+        }
+        for r in reqs:
+            L = len(r.prompt)
+            feed["prompt_ids"][r.slot, :L] = r.prompt
+            feed["prompt_mask"][r.slot, :L] = 1.0
+            feed["prompt_len"][r.slot, 0] = L
+            feed["slot_mask"][r.slot, 0] = 1.0
+        return feed
+
+    def _run_prefill(self, newcomers: List[_GenRequest]) -> None:
+        by_bucket = defaultdict(list)
+        for r in newcomers:
+            by_bucket[r.bucket].append(r)
+        for bucket in sorted(by_bucket):
+            reqs = by_bucket[bucket]
+            net = self._model["prefill"][bucket]
+            span = _trace.NOOP_SPAN
+            if _trace.enabled():
+                span = _trace.root_span(
+                    "serving.prefill", bucket=bucket, requests=len(reqs),
+                    request_traces=",".join(r.span.trace_id for r in reqs))
+                for r in reqs:
+                    r.dispatch_span = _trace.start_span(
+                        "serving.dispatch", parent=r.span, phase="prefill",
+                        bucket=bucket, slot=r.slot)
+            try:
+                _faults.fault_point("batch_dispatch")
+                feed = self._prefill_feed(bucket, reqs)
+                t0 = time.perf_counter()
+                with _trace.attach(span):
+                    outs = self._exe.run(net["main"], feed=feed,
+                                         fetch_list=[net["first_token"].name],
+                                         scope=self._scope)
+                dt = time.perf_counter() - t0
+            except _faults.InjectedFault as e:
+                # fired before any dispatch: state intact, only this
+                # group fails (typed) — the engine keeps serving
+                span.end(error=e)
+                self._fail_group(reqs, e, phase="prefill")
+                continue
+            except Exception as e:
+                # a real failure may have consumed donated state buffers:
+                # fail every resident stream typed + reset the state
+                span.end(error=e)
+                self._fail_all_resident(e, phase="prefill")
+                return
+            span.end()
+            self._note_compiles("prefill", bucket, net["main"])
+            if _monitor.enabled():
+                _monitor.histogram(
+                    "serving_prefill_seconds",
+                    "wall time of one slot-masked prefill dispatch"
+                ).observe(dt)
+            first = np.asarray(outs[0]).reshape(len(self._slots))
+            for r in reqs:
+                if r.deadline is not None and r.deadline.expired:
+                    self._retire(r)
+                    self._settle_error(
+                        r, "deadline_exceeded",
+                        DeadlineExceeded(r.deadline.what,
+                                         r.deadline.budget_s,
+                                         r.deadline.elapsed()),
+                        dispatched=True)
+                    continue
+                if _monitor.enabled():
+                    _monitor.histogram(
+                        "serving_first_token_seconds",
+                        "submit-to-first-token latency (prefill + queue)"
+                    ).observe(time.monotonic() - r.submitted)
+                # the first token's cost is the FIRST-TOKEN histogram's
+                # story — it must not pollute the inter-token latency
+                self._emit(r, [int(first[r.slot])], dt,
+                           record_intertoken=False)
+
+    # -- decode ----------------------------------------------------------
+    def _run_decode_chunk(self) -> None:
+        active = [r for r in self._slots if r is not None]
+        steps = self.gen_config.decode_chunk
+        span = _trace.NOOP_SPAN
+        if _trace.enabled():
+            span = _trace.root_span(
+                "serving.decode", steps=steps, requests=len(active),
+                request_traces=",".join(r.span.trace_id for r in active))
+        try:
+            _faults.fault_point("batch_dispatch")
+            t0 = time.perf_counter()
+            with _trace.attach(span):
+                outs = self._exe.run_chained(
+                    self._program, feed={}, fetch_list=self._fetch_names,
+                    steps=steps, scope=self._scope)
+            dt = time.perf_counter() - t0
+        except _faults.InjectedFault as e:
+            # the chaos gate's kill-one-batch: every stream in THIS batch
+            # settles typed; state untouched (the fault fires before the
+            # dispatch), freed slots are re-prefilled next iteration
+            span.end(error=e)
+            self._fail_group(active, e, phase="decode")
+            return
+        except Exception as e:
+            span.end(error=e)
+            self._fail_all_resident(e, phase="decode")
+            return
+        span.end()
+        self._note_compiles("decode", len(self._slots), self._program)
+        toks = np.asarray(outs[0]).reshape(steps, len(self._slots))
+        per_tok = dt / steps
+        if _monitor.enabled():
+            _monitor.histogram(
+                "serving_decode_chunk_seconds",
+                "wall time of one chained decode chunk").observe(dt)
+        for r in active:
+            if r.deadline is not None and r.deadline.expired:
+                # mid-stream expiry: the typed outcome is the LAST word —
+                # this chunk's tokens are discarded, the ones already
+                # streamed remain readable as partial results
+                self._retire(r)
+                self._settle_error(
+                    r, "deadline_exceeded",
+                    DeadlineExceeded(r.deadline.what, r.deadline.budget_s,
+                                     r.deadline.elapsed()),
+                    dispatched=True)
+                continue
+            take = toks[:r.max_new - r.emitted, r.slot]
+            eos = self.gen_config.eos_id
+            if eos >= 0:
+                hits = np.nonzero(take == eos)[0]
+                if hits.size:
+                    take = take[:int(hits[0]) + 1]
+            self._emit(r, [int(t) for t in take], per_tok * len(take))
+
+    # -- shared settle paths ---------------------------------------------
+    def _emit(self, r: _GenRequest, toks: List[int], dt: float,
+              record_intertoken: bool = True) -> None:
+        """Stream ``toks`` to the future (partial results) and settle the
+        request when it reaches its token budget or stop token.
+        ``record_intertoken=False`` on the prefill-produced first token:
+        its cost belongs to ``serving_first_token_seconds``, not the
+        inter-token distribution."""
+        if toks:
+            r.future._emit_tokens(toks)
+            r.out_tokens.extend(toks)
+            r.emitted += len(toks)
+            if _monitor.enabled():
+                _monitor.counter(
+                    "serving_decode_tokens_total",
+                    "tokens streamed to generative requests").inc(len(toks))
+                if record_intertoken:
+                    h = _monitor.histogram(
+                        "serving_intertoken_seconds",
+                        "per-token wall time within a decode chunk "
+                        "(p50/p99 in the snapshot)")
+                    for _ in toks:
+                        h.observe(dt / len(toks))
+        done = r.emitted >= r.max_new
+        eos = self.gen_config.eos_id
+        if not done and eos >= 0 and toks and toks[-1] == eos:
+            done = True
+        if done:
+            self._retire(r)
+            latency = time.monotonic() - r.submitted
+            with self._lock:
+                self._acct["completed"] += 1
+                self._dispatched -= 1
+            self._record_outcome("completed")
+            self._finish_request(r, "completed")
+            if _monitor.enabled():
+                _monitor.histogram(
+                    "serving_request_latency_seconds",
+                    "submit-to-response latency of completed requests "
+                    "(p50/p99 in the snapshot)").observe(latency)
+            r.future._settle(
+                result=[np.asarray(r.out_tokens, dtype=np.int64)])
+
+    def _retire(self, r: _GenRequest) -> None:
+        if 0 <= r.slot < len(self._slots) and self._slots[r.slot] is r:
+            self._slots[r.slot] = None
+
+    def _fail_group(self, reqs: List[_GenRequest], err: BaseException,
+                    phase: str) -> None:
+        logger.warning(
+            "serving: %s dispatch of %d stream(s) failed (%s: %s) — "
+            "failing those streams typed, engine continues",
+            phase, len(reqs), type(err).__name__, err)
+        if _monitor.enabled():
+            _monitor.counter("serving_batches_total",
+                             "dispatched batches by result").labels(
+                result="failed").inc()
+        for r in reqs:
+            self._retire(r)
+            e = BatchFailed(
+                f"serving: {phase} batch failed for stream #{r.seq}: "
+                f"{type(err).__name__}: {err}")
+            e.__cause__ = err
+            self._settle_error(r, "failed", e, dispatched=True)
+        _trace.record_incident(
+            "batch_failed", error=err,
+            context=reqs[0].span if reqs else None,
+            detail=f"generative {phase}, {len(reqs)} stream(s)")
+
+    def _fail_all_resident(self, err: BaseException, phase: str) -> None:
+        resident = [r for r in self._slots if r is not None]
+        logger.error(
+            "serving: %s dispatch raised %s — generation state may hold "
+            "consumed buffers; failing all %d resident stream(s) typed "
+            "and resetting the generation state",
+            phase, type(err).__name__, len(resident))
+        self._fail_group(resident, err, phase)
+        self.reset_generation_state()
+
+    # -- observability ---------------------------------------------------
+    def _program_steps(self, program) -> frozenset:
+        """Identities of the executor-cached compiled steps belonging to
+        ``program`` — run-path keys lead with the program fingerprint
+        ``(serial, ...)``, chained keys with ``("chained", fingerprint,
+        ...)``. Scoped per program so unrelated compiles on a SHARED
+        executor (a trainer thread, a sibling engine) can never read as
+        this engine's recompiles."""
+        serial = getattr(program, "_serial", None)
+        with self._exe._lock:
+            return frozenset(
+                id(step) for key, step in self._exe._cache.items()
+                if (key[0] == "chained" and key[1][0] == serial)
+                or (isinstance(key[0], tuple) and key[0]
+                    and key[0][0] == serial))
+
+    def _note_compiles(self, phase: str, bucket: int, program) -> None:
+        """The bucketed-recompile watchdog: a (phase, bucket) whose
+        executable already exists must NEVER compile again — positions
+        move, shapes don't. A NEW compiled step appearing for this
+        phase's program after its first compile is counted on
+        ``serving_decode_recompiles_total`` and logged loudly; the
+        ``load_check --decode`` gate fails on a non-zero total."""
+        key = (phase, int(bucket))
+        steps = self._program_steps(program)
+        prev = self._compiled_buckets.get(key)
+        if prev is None:
+            self._compiled_buckets[key] = steps
+            return
+        if steps - prev:
+            self.decode_recompiles += 1
+            logger.error(
+                "serving: RECOMPILE on warm (phase=%s, bucket=%s) — a new "
+                "executable was compiled for a program that was already "
+                "compiled; KV growth must never reshape a decode dispatch",
+                phase, bucket)
+            if _monitor.enabled():
+                _monitor.counter(
+                    "serving_decode_recompiles_total",
+                    "executable compiles beyond one per (phase, bucket) — "
+                    "always a bug; gated to zero in CI").labels(
+                    phase=phase, bucket=str(bucket)).inc()
+            self._compiled_buckets[key] = prev | steps
+
+    def _gauge_kv_occupancy(self) -> None:
+        if not _monitor.enabled():
+            return
+        pages = self._max_seq // self._page_size
+        used = 0
+        for r in self._slots:
+            if r is not None:
+                length = min(len(r.prompt) + r.emitted, self._max_seq)
+                used += -(-length // self._page_size)   # ceil
+        _monitor.gauge(
+            "serving_kv_page_occupancy",
+            "fraction of KV cache pages held by resident sequences"
+        ).set(used / (pages * len(self._slots)))
+
+    def generation_stats(self) -> dict:
+        """Decode-side snapshot for reports: resident slots, compiled
+        (phase, bucket) executables, recompiles."""
+        resident = [r.seq for r in self._slots if r is not None]
+        return {
+            "slots": len(self._slots),
+            "resident": resident,
+            "compiled_buckets": sorted(
+                f"{p}:{b}" for (p, b) in self._compiled_buckets),
+            "decode_recompiles": self.decode_recompiles,
+            "max_seq": self._max_seq,
+            "page_size": self._page_size,
+            "prompt_buckets": list(self._buckets),
+        }

@@ -74,6 +74,10 @@ ALLOWLIST = {
     ("PT721", "softmax_with_cross_entropy"):
         "the Softmax output is read only by the grad op; forward-only "
         "clones keep the slot per the reference schema",
+    ("PT721", "layer_norm"):
+        "Mean/Variance are grad-side state slots read only by "
+        "layer_norm_grad; inference-only programs (the GPT generative "
+        "phases) never read them",
 }
 
 # dead-code findings gate the zoo unless allowlisted; everything else
@@ -173,6 +177,20 @@ def _zoo_programs():
         m = build_seq2seq_train(src_vocab=50, tgt_vocab=50)
         out.append(("zoo/seq2seq/main", m["main"], [m["loss"].name]))
         out.append(("zoo/seq2seq/startup", m["startup"], []))
+    with un.guard():
+        from paddle_tpu.models import GptConfig, build_gpt_generative
+
+        # both generative phases, incl. the PT710s donation-race pass
+        # over the donated KV caches (the ISSUE 11 satellite contract)
+        m = build_gpt_generative(GptConfig.tiny(), batch_slots=2,
+                                 max_seq=32, page_size=8,
+                                 prompt_buckets=(16,))
+        pf = m["prefill"][16]
+        out.append(("zoo/gpt_tiny/prefill", pf["main"],
+                    [pf["first_token"].name]))
+        out.append(("zoo/gpt_tiny/decode", m["decode"]["main"],
+                    [m["decode"]["next_token"].name]))
+        out.append(("zoo/gpt_tiny/startup", m["startup"], []))
     return out
 
 

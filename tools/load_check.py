@@ -110,6 +110,27 @@ def _bert_engine(ci: bool, config: serving.ServingConfig):
     return eng, feed
 
 
+def _gpt_engine(ci: bool, config: serving.ServingConfig,
+                gen_config=None):
+    """GPT-tiny generative engine (prefill/decode split scheduling over a
+    paged KV cache) — the --decode legs' probe."""
+    from paddle_tpu.models.gpt import GptConfig, build_gpt_generative
+    import paddle_tpu.unique_name as un
+
+    with un.guard():
+        net = build_gpt_generative(
+            GptConfig.tiny(), batch_slots=4, max_seq=32, page_size=8,
+            prompt_buckets=(8, 16))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(net["startup"], scope=scope)
+    eng = serving.GenerativeEngine(
+        net, scope=scope, executor=exe, config=config,
+        gen_config=gen_config or serving.GenerationConfig(decode_chunk=2))
+    return eng
+
+
 # ---------------------------------------------------------------------------
 # traffic
 # ---------------------------------------------------------------------------
@@ -265,6 +286,152 @@ def leg_chaos(name, make_engine, ci, shedding=True):
                    "overload + compile faults + a watchdog-broken hang"}
 
 
+def _drive_generate(eng, n_requests, n_threads, deadline_s=None,
+                    seed=0):
+    """Submit ``n_requests`` generation prompts from ``n_threads`` threads
+    and wait for every terminal outcome. Returns caller-side outcome
+    counts plus the expected/streamed token totals."""
+    seen = {"completed": 0, "overloaded": 0, "deadline": 0,
+            "batch_failed": 0, "stopped": 0, "injected": 0,
+            "other_error": 0, "tokens_expected": 0, "tokens_streamed": 0}
+    lock = threading.Lock()
+    futures = []
+
+    def note(key, n=1):
+        with lock:
+            seen[key] += n
+
+    def submitter(tid):
+        rng = np.random.RandomState(seed + tid)
+        for i in range(tid, n_requests, n_threads):
+            plen = 3 + (i % 10)
+            max_new = 2 + (i % 5)
+            try:
+                fut = eng.submit(rng.randint(1, 128, plen),
+                                 max_new_tokens=max_new,
+                                 deadline_s=deadline_s, priority=i % 3)
+                with lock:
+                    futures.append((fut, max_new))
+            except serving.Overloaded:
+                note("overloaded")
+            except serving.EngineStopped:
+                note("stopped")
+            except Exception as e:
+                from paddle_tpu.resilience.faults import InjectedFault
+
+                note("injected" if isinstance(e, InjectedFault)
+                     else "other_error")
+
+    threads = [threading.Thread(target=submitter, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    for fut, max_new in futures:
+        err = fut.exception(timeout=600)
+        note("tokens_streamed", len(fut.tokens()))
+        if err is None:
+            note("completed")
+            note("tokens_expected", max_new)
+            assert len(fut.result()[0]) == max_new
+        elif isinstance(err, serving.DeadlineExceeded):
+            note("deadline")
+        elif isinstance(err, serving.BatchFailed):
+            note("batch_failed")
+        elif isinstance(err, serving.EngineStopped):
+            note("stopped")
+        else:
+            note("other_error")
+    seen["submitted"] = n_requests
+    seen["terminal"] = sum(v for k, v in seen.items()
+                           if k in ("completed", "overloaded", "deadline",
+                                    "batch_failed", "stopped", "injected",
+                                    "other_error"))
+    return seen
+
+
+def _decode_metrics(t_wall):
+    toks = monitor.metric_value("serving_decode_tokens_total", 0.0)
+    it = monitor.metric_value("serving_intertoken_seconds", default=None)
+    out = {"tokens_total": toks,
+           "tokens_per_s": (toks / t_wall) if t_wall > 0 else None}
+    if isinstance(it, dict):
+        out["intertoken_p50_ms"] = (it["p50"] or 0.0) * 1e3
+        out["intertoken_p99_ms"] = (it["p99"] or 0.0) * 1e3
+        out["intertoken_count"] = it["count"]
+    return out
+
+
+def leg_decode(name, ci):
+    """GPT-tiny generation burst from multiple threads: every stream
+    completes with exact per-stream accounting, one executable per
+    (phase, bucket) — zero warm recompiles — and tokens/s + inter-token
+    p50/p99 land in the artifact."""
+    cfg = serving.ServingConfig(max_batch=4, queue_depth=64, deadline_s=0)
+    eng = _gpt_engine(ci, cfg)
+    eng.warm_up()
+    n = 12 if ci else 48
+    t0 = time.time()
+    with eng:
+        seen = _drive_generate(eng, n_requests=n, n_threads=3)
+    t_wall = time.time() - t0
+    acct = eng.accounting()
+    stats = eng.generation_stats()
+    metrics = _decode_metrics(t_wall)
+    checks = {
+        "exact_accounting": bool(acct["exact"]),
+        "every_submit_terminal": seen["terminal"] == seen["submitted"],
+        "all_completed": seen["completed"] == n,
+        "token_counts_exact":
+            seen["tokens_streamed"] == seen["tokens_expected"],
+        "no_untyped_errors": seen["other_error"] == 0,
+        "zero_warm_recompiles": stats["decode_recompiles"] == 0,
+        "one_executable_per_phase_bucket":
+            len(stats["compiled_buckets"]) == 3,
+        "intertoken_histogram_present":
+            metrics.get("intertoken_count", 0) > 0,
+    }
+    return {"name": name, "ok": all(checks.values()), "requests": n,
+            "caller_view": seen, "engine_accounting": acct,
+            "checks": checks, "generation": stats, "decode": metrics,
+            "why": "multi-thread generation burst: exact accounting, "
+                   "bounded compiles, streaming SLO metrics"}
+
+
+def leg_decode_chaos(name, ci):
+    """Kill one in-flight decode/prefill batch (injected batch_dispatch
+    fault): every affected stream must settle with a typed outcome, the
+    engine keeps serving, accounting stays exact."""
+    cfg = serving.ServingConfig(max_batch=4, queue_depth=64, deadline_s=0)
+    eng = _gpt_engine(ci, cfg)
+    eng.warm_up()
+    n = 12 if ci else 32
+    t0 = time.time()
+    with eng:
+        with fault_plan_guard("batch_dispatch:@3:RuntimeError"):
+            seen = _drive_generate(eng, n_requests=n, n_threads=3, seed=7)
+        # the engine must keep serving AFTER the killed batch
+        post = eng.submit(np.array([3, 1, 4]), max_new_tokens=3)
+        post_ok = len(post.result(timeout=600)[0]) == 3
+    t_wall = time.time() - t0
+    acct = eng.accounting()
+    checks = {
+        "exact_accounting": bool(acct["exact"]),
+        "every_submit_terminal": seen["terminal"] == seen["submitted"],
+        "no_untyped_errors": seen["other_error"] == 0,
+        "killed_batch_settled_typed": seen["batch_failed"] >= 1,
+        "progress_under_chaos": seen["completed"] > 0,
+        "engine_serves_after_kill": post_ok,
+        "engine_drained": acct["pending"] == 0,
+    }
+    return {"name": name, "ok": all(checks.values()), "requests": n,
+            "caller_view": seen, "engine_accounting": acct,
+            "checks": checks, "decode": _decode_metrics(t_wall),
+            "why": "one in-flight batch killed: affected streams settle "
+                   "typed BatchFailed, engine keeps serving"}
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -281,6 +448,12 @@ def main(argv=None) -> int:
                     help="disable admission control; the gate must FAIL")
     ap.add_argument("--skip-bert", action="store_true",
                     help="resnet legs only (debugging)")
+    ap.add_argument("--decode", action="store_true",
+                    help="add the generative legs: a GPT-tiny multi-thread "
+                         "generation burst (exact accounting, zero warm "
+                         "recompiles, tokens/s + inter-token p50/p99 in "
+                         "the artifact) and a chaos sub-leg that kills one "
+                         "in-flight batch (affected streams settle typed)")
     args = ap.parse_args(argv)
     ci = args.ci or args.check
 
@@ -297,11 +470,21 @@ def main(argv=None) -> int:
         if not args.skip_bert:
             legs.append(leg_steady("steady_bert", _bert_engine, ci))
         legs.append(leg_chaos("chaos_resnet", _resnet_engine, ci))
+        if args.decode:
+            legs.append(leg_decode("decode_gpt", ci))
+            legs.append(leg_decode_chaos("decode_gpt_chaos", ci))
 
     latency = _latency_snapshot()
     gate_ok = all(l["ok"] for l in legs) and latency is not None \
         and latency["count"] > 0 and latency["p50"] is not None \
         and latency["p99"] is not None
+    decode_report = None
+    if args.decode and not args.negative_control:
+        decode_report = next((l["decode"] for l in legs
+                              if l["name"] == "decode_gpt"), None)
+        gate_ok = gate_ok and decode_report is not None \
+            and (decode_report.get("tokens_per_s") or 0) > 0 \
+            and decode_report.get("intertoken_p99_ms") is not None
 
     for l in legs:
         status = "ok" if l["ok"] else "MISS"
@@ -316,6 +499,11 @@ def main(argv=None) -> int:
               f"p50={latency['p50'] * 1e3:.1f}ms "
               f"p99={latency['p99'] * 1e3:.1f}ms "
               f"max={latency['max'] * 1e3:.1f}ms")
+    if decode_report:
+        print(f"decode: tokens={decode_report['tokens_total']:.0f} "
+              f"tokens/s={decode_report['tokens_per_s']:.1f} "
+              f"intertoken p50={decode_report['intertoken_p50_ms']:.2f}ms "
+              f"p99={decode_report['intertoken_p99_ms']:.2f}ms")
     print(f"serving gate ({time.time() - t0:.1f}s) -> "
           f"{'ok' if gate_ok else 'FAIL'}")
 
@@ -324,6 +512,7 @@ def main(argv=None) -> int:
             json.dump({
                 "legs": legs,
                 "latency_histogram": latency,
+                "decode": decode_report,
                 "snapshot": monitor.snapshot(),
                 "check": {"status": "ok" if gate_ok else "fail",
                           "negative_control": bool(args.negative_control)},
