@@ -31,6 +31,15 @@ echo "== peak-memory plan + PT5xx liveness gate (JSON report is the CI artifact)
 JAX_PLATFORMS=cpu python tools/mem_report.py --check \
   --json "${CI_ARTIFACT_DIR:-.}/ci_mem_report.json"
 
+echo "== per-chip memory plan gate (analysis/sharding_check: dp=8 ZeRO-1"
+echo "   spec propagation; per-chip peaks must fit the HBM budget, and the"
+echo "   static estimate must match the MEASURED live-sharding state bytes"
+echo "   of a dp-sharded zoo model within 10% — multichip dryrun)"
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python tools/mem_report.py --mesh dp=8 --specs zero1 --check \
+  --validate-live --hbm-budget-mb 15872 \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_mem_sharded_report.json" | tail -6
+
 echo "== executor metrics + recompile gate (paddle_tpu.monitor; JSON artifact)"
 JAX_PLATFORMS=cpu python tools/metrics_report.py --check \
   --json "${CI_ARTIFACT_DIR:-.}/ci_metrics_report.json"

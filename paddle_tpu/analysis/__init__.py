@@ -43,7 +43,11 @@ from .pass_manager import (ALL_ANALYSIS_PASSES, VERIFY_PASSES, FunctionPass,
 from . import static_checks
 from .static_checks import (DceDecision, DeadCodeReport, dce_program)
 from . import cost_model
-from .cost_model import CostReport, estimate_cost
+from .cost_model import (CommsReport, CostReport, comms_compute_ratio,
+                         estimate_comms, estimate_cost)
+from . import sharding_check
+from .sharding_check import (CollectiveEvent, ShardingAnalysis,
+                             propagate_sharding)
 
 __all__ = [
     "CODES", "Diagnostic", "ProgramVerificationError", "Severity",
@@ -59,5 +63,8 @@ __all__ = [
     "run_verify_pipeline", "run_transform_pipeline", "clear_analysis_caches",
     "ALL_ANALYSIS_PASSES", "VERIFY_PASSES",
     "static_checks", "DceDecision", "DeadCodeReport", "dce_program",
-    "cost_model", "CostReport", "estimate_cost",
+    "cost_model", "CostReport", "estimate_cost", "CommsReport",
+    "estimate_comms", "comms_compute_ratio",
+    "sharding_check", "CollectiveEvent", "ShardingAnalysis",
+    "propagate_sharding",
 ]

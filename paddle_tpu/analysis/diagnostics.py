@@ -113,6 +113,57 @@ CODES = {
     "PT722": (Severity.WARNING,
               "unreachable sub-block: no op references the block via its "
               "sub_block attr"),
+    # -- pass: static SPMD sharding analysis (sharding_check) -----------
+    "PT730": (Severity.ERROR,
+              "sharding spec references a mesh axis the mesh does not "
+              "have"),
+    "PT731": (Severity.ERROR,
+              "sharding spec names more dims than the var has"),
+    "PT732": (Severity.ERROR,
+              "one mesh axis shards two different dims of the same var"),
+    "PT733": (Severity.ERROR,
+              "shard-indivisible dim: the dim size is not divisible by "
+              "the mesh axis size"),
+    "PT734": (Severity.WARNING,
+              "inconsistent input specs: dims that must agree elementwise "
+              "arrive with different shardings — GSPMD inserts a reshard "
+              "to reconcile them"),
+    "PT735": (Severity.WARNING,
+              "unsatisfiable contraction: the contracted dims of a "
+              "matmul-class op arrive sharded over different axes — no "
+              "partial-sum layout satisfies both without resharding"),
+    "PT736": (Severity.WARNING,
+              "implicit full replication: a large tensor produced from "
+              "sharded inputs comes out fully replicated — every chip "
+              "holds (and pays for) the whole value"),
+    "PT737": (Severity.WARNING,
+              "resharding inside the training loop: a persistable var is "
+              "produced with a different layout than it enters with — "
+              "every step pays the layout change"),
+    "PT738": (Severity.WARNING,
+              "gradient spec disagrees with its param's spec at the "
+              "optimizer update — the grad is resharded every step"),
+    "PT739": (Severity.WARNING,
+              "optimizer-state spec disagrees with its param's spec "
+              "outside the recognized ZeRO dim-0-over-dp layout"),
+    "PT740": (Severity.INFO,
+              "ZeRO layout: optimizer state sharded over dp against a "
+              "replicated param — each step pays a grad reduce-scatter "
+              "plus a param all-gather (the intended trade)"),
+    "PT741": (Severity.WARNING,
+              "donation invalidated by resharding: the liveness proof "
+              "donates the buffer but its input and output layouts "
+              "differ, so in-place reuse is impossible (extends PT710)"),
+    "PT742": (Severity.WARNING,
+              "feed not sharded over the mesh's dp axis: the global "
+              "batch rides every chip whole — data parallelism is not "
+              "engaged"),
+    "PT743": (Severity.WARNING,
+              "sharded fetch: the executor pins fetches replicated, so "
+              "every step all-gathers the fetched value"),
+    "PT744": (Severity.INFO,
+              "no sharding propagation rule for this op: specs are "
+              "conservatively replicated past it"),
 }
 
 

@@ -71,8 +71,10 @@ TRANSFORM = "transform"
 # check_program) and the full static-analysis suite the lint CLI drives
 VERIFY_PASSES = ("schema", "dataflow", "lowerability", "shape_replay",
                  "liveness")
+# sharding_check is a silent no-op without a mesh option, so the full
+# lint pipeline can always include it
 ALL_ANALYSIS_PASSES = VERIFY_PASSES + ("dtype_shape_check", "donation_race",
-                                       "dead_code")
+                                       "dead_code", "sharding_check")
 
 class PassVerificationError(ProgramVerificationError):
     """A transform pass broke the pipeline invariant: ``verify_program``

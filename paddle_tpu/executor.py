@@ -329,15 +329,18 @@ def unpack_step_result(step, result, scope, to_host=np.asarray, *,
     3-tuple result carries the per-op finite flags.
 
     On a tripped check the outcome depends on ``FLAGS_nan_inf_policy``
-    (resilience.nonfinite). With ``rollback=None`` (policy ``raise``, or a
-    path that could not preserve pre-step buffers) the step's outputs are
-    written back FIRST (inputs were donated — without this the scope would
-    reference deleted buffers and the session would be unusable after
-    catching the error), then FloatingPointError names the op. With a
-    ``rollback`` list of ``(name, pre-step value)`` pairs the step is
-    DROPPED instead: the scope is restored bit-exactly, the skip is
-    counted (``steps_skipped_nonfinite_total``), and ``(fetches, None)``
-    is returned — the caller must skip its state writeback."""
+    (resilience.nonfinite). With a ``rollback`` list of ``(name, pre-step
+    value)`` pairs the scope is restored bit-exactly first; policy
+    ``raise`` then raises FloatingPointError naming the op (catching it
+    leaves a usable session on pre-step state), while ``skip``/
+    ``zero_grad`` DROP the step — the skip is counted
+    (``steps_skipped_nonfinite_total``) and ``(fetches, None)`` is
+    returned, the caller skipping its state writeback. With
+    ``rollback=None`` (a path that could not preserve pre-step buffers,
+    e.g. multi-process global arrays) the step's outputs are written back
+    FIRST (inputs were donated — without this the scope would reference
+    deleted buffers and the session would be unusable after catching the
+    error), then FloatingPointError names the op."""
     if len(result) != 3:
         return result
     fetches, new_state, ok_vec = result
@@ -355,6 +358,10 @@ def unpack_step_result(step, result, scope, to_host=np.asarray, *,
             f"FLAGS_check_nan_inf: non-finite value in {label}")
     for n, v in rollback:
         scope.set_var(n, v)
+    if _nonfinite.policy() == "raise":
+        raise FloatingPointError(
+            f"FLAGS_check_nan_inf: non-finite value in {label} "
+            f"(scope restored to pre-step values)")
     # counted AFTER the restore so even skip->raise escalation leaves the
     # scope holding the pre-step values
     _nonfinite.record_skip(path, label, exe)
@@ -985,6 +992,11 @@ class Executor:
                         f"provenance)")
                 for n, v in rollback:
                     scope.set_var(n, v)
+                if _nonfinite.policy() == "raise":
+                    raise FloatingPointError(
+                        f"FLAGS_check_nan_inf: non-finite value in {label} "
+                        f"(run_chained coarse check, scope restored to "
+                        f"pre-scan values; use run for per-op provenance)")
                 _nonfinite.record_skip("chained", label, self)
                 if return_numpy:
                     return [np.asarray(v) for v in stacked]

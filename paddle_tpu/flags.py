@@ -139,6 +139,12 @@ _DEFS: Dict[str, tuple] = {
                            "cost-model MFU gauges (default: v5e bf16 "
                            "peak; set per deployment). "
                            "docs/PERF_NOTES.md"),
+    "ici_gbytes_per_s": (float, 100.0,
+                         "effective per-chip interconnect bandwidth "
+                         "(GB/s) for the predicted comms-vs-compute "
+                         "ratio (analysis.cost_model.estimate_comms); "
+                         "default a conservative v5e ICI figure — set "
+                         "per deployment. docs/PERF_NOTES.md"),
     "fault_seed": (int, 0,
                    "seed for probabilistic fault-plan rules and retry "
                    "jitter — the same plan+seed replays identically"),

@@ -519,17 +519,43 @@ class Program:
         return p
 
     def memory_plan(self, feed_names: Sequence[str] = (),
-                    fetch_names: Sequence[str] = (), batch_size: int = 1):
+                    fetch_names: Sequence[str] = (), batch_size: int = 1,
+                    mesh: Optional[Dict[str, int]] = None,
+                    specs: Optional[Dict[str, tuple]] = None):
         """Static peak-memory plan for the global block: a linear-scan
         estimate of live bytes per op index with weights / gradients /
         optimizer state / activations split out (the analysis layer of the
         reference's ir/memory_optimize_pass family). ``-1`` dims resolve to
         ``batch_size``. See ``paddle_tpu.analysis.liveness.memory_plan``
-        and ``tools/mem_report.py``."""
+        and ``tools/mem_report.py``.
+
+        With ``mesh`` (``{"dp": 8, ...}``) the plan is **per chip** under a
+        sharding assignment: ``specs`` (name -> PartitionSpec-like tuple,
+        e.g. from ``parallel.sharding.extract_param_specs``) seeds
+        ``analysis.sharding_check.propagate_sharding``, live bytes divide
+        per propagated spec (replicated tensors count whole), and
+        collective staging buffers are charged at their emitting op. The
+        resulting plan carries the analysis on ``plan.sharding``. With
+        ``mesh=None`` the path and numbers are identical to the
+        single-device planner."""
         from .analysis.liveness import memory_plan as _memory_plan
 
-        return _memory_plan(self, feed_names=feed_names,
-                            fetch_names=fetch_names, batch_size=batch_size)
+        if mesh is None:
+            return _memory_plan(self, feed_names=feed_names,
+                                fetch_names=fetch_names,
+                                batch_size=batch_size)
+        from .analysis.sharding_check import (propagate_sharding,
+                                              staging_bytes_by_op)
+
+        analysis = propagate_sharding(
+            self, mesh, param_specs=specs, feed_names=feed_names,
+            fetch_names=fetch_names, batch_size=batch_size)
+        plan = _memory_plan(self, feed_names=feed_names,
+                            fetch_names=fetch_names, batch_size=batch_size,
+                            mesh=analysis.mesh, specs=analysis.var_specs,
+                            staging=staging_bytes_by_op(analysis))
+        plan.sharding = analysis
+        return plan
 
     def list_vars(self):
         for blk in self.blocks:

@@ -52,6 +52,7 @@ __all__ = [
     "observe_compile", "complete_compile", "step_begin", "step_end",
     "record_pass", "record_remat", "record_watchdog_timeout",
     "program_cost", "observe_step_cost", "observe_serving_cost",
+    "observe_comms_cost",
     "recompile_events",
     "recompile_count", "snapshot", "reset", "get_tracker", "build_site",
 ]
@@ -281,6 +282,31 @@ def observe_serving_cost(program, padded_rows: int, batch_s: float,
               "FLAGS_device_peak_tflops, per shape bucket").labels(
             bucket=bucket).set(achieved / peak)
     return achieved
+
+
+def observe_comms_cost(program, comms, cost=None) -> None:
+    """Static-sharding comms gauges (analysis.cost_model.estimate_comms):
+    ``executor_comms_gbytes_per_step`` — predicted per-chip collective
+    wire volume of one step under the compiled sharding assignment — and
+    ``executor_comms_compute_ratio`` — predicted wire time over MXU time
+    (>1 = communication-bound). Labels carry the program serial and the
+    mesh shape so multi-mesh runs stay distinguishable."""
+    if not enabled() or comms is None:
+        return
+    labels = {"program": str(int(getattr(program, "_serial", -1))),
+              "mesh": "x".join(f"{k}={v}"
+                               for k, v in sorted(comms.mesh.items()))}
+    gauge("executor_comms_gbytes_per_step",
+          "predicted per-chip collective wire GB of one step under the "
+          "static sharding assignment, by program and mesh").labels(
+        **labels).set(comms.gbytes_per_step)
+    if cost is not None and cost.flops_total > 0:
+        from ..analysis.cost_model import comms_compute_ratio
+
+        gauge("executor_comms_compute_ratio",
+              "predicted comms-vs-compute time ratio of one step "
+              "(>1 = communication-bound), by program and mesh").labels(
+            **labels).set(comms_compute_ratio(comms, cost))
 
 
 def record_watchdog_timeout(section: str) -> None:

@@ -371,7 +371,40 @@ class CompiledProgram:
                 },
                 donated_names=step.donated_names), None, None)
             self._cache[key] = step
-            return step
+        # outside the cache lock: a pure-metadata walk, but no reason to
+        # queue concurrent cache hits behind it
+        self._observe_static_sharding(program, fetch_names, feed)
+        return step
+
+    def _observe_static_sharding(self, program, fetch_names, feed) -> None:
+        """Predicted per-chip collective volume + comms-vs-compute gauges
+        for the layout this compile just fixed (analysis.sharding_check
+        over the same zero1_spec_for rule the executable was built with).
+        Advisory: never raises into a step."""
+        if not _monitor.enabled() or self._mesh is None:
+            return
+        try:
+            from ..analysis.cost_model import estimate_comms, estimate_cost
+            from ..analysis.sharding_check import propagate_sharding
+            from ..executor import _feed_batch_rows
+            from .sharding import extract_param_specs
+
+            mesh_shape = {str(k): int(v)
+                          for k, v in dict(self._mesh.shape).items()}
+            zero = (self._build_strategy.reduce_strategy
+                    == ReduceStrategy.Reduce)
+            specs, feed_spec = extract_param_specs(program, mesh_shape,
+                                                   zero=zero)
+            batch = _feed_batch_rows(feed) or 1
+            analysis = propagate_sharding(
+                program, mesh_shape, param_specs=specs,
+                feed_spec=feed_spec, feed_names=list(feed.keys()),
+                fetch_names=fetch_names, batch_size=batch)
+            _monitor.observe_comms_cost(
+                program, estimate_comms(analysis),
+                estimate_cost(program, batch_size=batch))
+        except Exception:
+            pass
 
     def _compile(self, program: Program, feed_names: set, fetch_names, scope):
         """Same env-threading as Executor._compile, but jitted with shardings
@@ -400,24 +433,17 @@ class CompiledProgram:
         zero1 = self._build_strategy.reduce_strategy == ReduceStrategy.Reduce
         dp = mesh.shape.get("dp", 1)
 
-        def _row_shard(v):
-            return NamedSharding(mesh, P(*(["dp"] + [None] * (len(v.shape) - 1))))
-
         def state_sharding(name):
-            if dp <= 1:
-                return repl_spec
+            # the metadata rule is shared with the static sharding_check
+            # pass (parallel/sharding.py), so the layout the analysis
+            # reasons about IS the one this executable runs
+            from .sharding import zero1_spec_for
+
             v = block.var(name) if block.has_var(name) else None
-            if v is None or not v.shape or len(v.shape) < 1 \
-                    or v.shape[0] < dp or v.shape[0] % dp:
+            spec = zero1_spec_for(v, dp, zero1)
+            if not spec:
                 return repl_spec
-            # sharded embedding table (is_sparse/is_distributed): row-shard
-            # over the mesh regardless of reduce strategy — the PS-table
-            # replacement; its accumulators carry the same tag
-            if getattr(v, "is_distributed", False):
-                return _row_shard(v)
-            if zero1 and getattr(v, "is_optimizer_state", False):
-                return _row_shard(v)
-            return repl_spec
+            return NamedSharding(mesh, P(*spec))
 
         state_shardings = {n: state_sharding(n)
                            for n in set(io["state_in"]) | set(io["state_out"])}

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .sharding import axis_size, shard_map_compat
+
 __all__ = ["ring_attention", "ring_attention_local", "attention_reference"]
 
 
@@ -63,7 +65,7 @@ def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool,
     from ..kernels import flash_attention_with_lse
 
     B, Tl, H, D = q.shape
-    P_ = jax.lax.axis_size(axis_name)
+    P_ = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     perm = [(i, (i + 1) % P_) for i in range(P_)]
@@ -122,7 +124,7 @@ def _ring_attention_local_jnp(q, k, v, axis_name: str, causal: bool = False,
                               scale: Optional[float] = None):
     """Einsum ring body (runs anywhere, incl. the 8-device CPU test mesh)."""
     B, Tl, H, D = q.shape
-    P_ = jax.lax.axis_size(axis_name)
+    P_ = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     q = q * scale
@@ -180,11 +182,6 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "sp",
                    use_flash: Optional[bool] = None):
     """shard_map wrapper: q/k/v [B, T, H, D] (global); T shards over
     ``seq_axis``, batch over 'dp' when the mesh has one."""
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
-
     batch_axis = "dp" if "dp" in mesh.axis_names else None
     spec = P(batch_axis, seq_axis, None, None)
 
@@ -198,7 +195,7 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "sp",
     # check_vma=False on the flash path: the kernel's scalar operands
     # (global position offsets) legitimately vary over the ring axis, which
     # the vma checker's pallas handling rejects
-    fn = shard_map(
+    fn = shard_map_compat(
         partial(ring_attention_local, axis_name=seq_axis, causal=causal,
                 scale=scale, use_flash=use_flash),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

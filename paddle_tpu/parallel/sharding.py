@@ -20,6 +20,49 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``shard_map`` across the jax API rename: newer jax spells the
+    replication-check kwarg ``check_vma``, 0.4.x spells it ``check_rep``
+    (same semantics). Callers use the new spelling; this maps it to
+    whichever the installed jax accepts."""
+    import inspect
+
+    try:
+        from jax import shard_map as _sm
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map as _sm
+    params = inspect.signature(_sm).parameters
+    kw = "check_vma" if "check_vma" in params else "check_rep"
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               **{kw: check_vma})
+
+
+def axis_size(axis_name: str):
+    """``jax.lax.axis_size`` where it exists; the ``psum(1, axis)``
+    idiom (folded to a constant at trace time) on 0.4.x."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def has_varying_types() -> bool:
+    """Does the installed jax type values as varying-over-axis inside
+    shard_map (``pcast``/``pvary``)? 0.4.x has neither — callers that
+    need a varying scan carry disable the replication check instead."""
+    return hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
+
+
+def pvary_compat(t, axis_name: str):
+    """Type ``t`` as varying over ``axis_name`` inside shard_map, across
+    the jax API generations (``pcast(to="varying")`` / ``pvary``); a
+    no-op on 0.4.x, where the caller must pass ``check_vma=False``."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(t, (axis_name,), to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(t, (axis_name,))
+    return t
+
+
 def make_mesh(shape: Dict[str, int], devices=None) -> Mesh:
     """mesh({'dp': 2, 'tp': 4}) over the first prod(shape) devices.
     Axis order follows dict order; put the fastest-varying (intra-chip ICI
@@ -111,6 +154,66 @@ def compile_sharded_step(program, mesh: Mesh, feed_names: Sequence[str],
                      donate_argnums=(1,) if donate else ())
     io["nan_check_meta"] = nan_meta
     return jitted, io
+
+
+# ---------------------------------------------------------------------------
+# static spec extraction (consumed by analysis.sharding_check and shared
+# with CompiledProgram._compile so the static layout IS the runtime layout)
+# ---------------------------------------------------------------------------
+
+def zero1_spec_for(v, dp: int, zero1: bool) -> tuple:
+    """Pure-metadata twin of CompiledProgram's ``state_sharding`` rule:
+    the PartitionSpec-like tuple (one axis name or None per dim) a state
+    var gets on a dp mesh. ``()`` = replicated. Sharded embedding tables
+    (``is_distributed``) row-shard regardless of the reduce strategy;
+    optimizer-state vars row-shard under ZeRO-1
+    (``BuildStrategy.ReduceStrategy.Reduce``)."""
+    if dp <= 1:
+        return ()
+    if v is None or not v.shape or len(v.shape) < 1 \
+            or v.shape[0] < dp or v.shape[0] % dp:
+        return ()
+    if getattr(v, "is_distributed", False):
+        return ("dp",)
+    if zero1 and getattr(v, "is_optimizer_state", False):
+        return ("dp",)
+    return ()
+
+
+def extract_param_specs(program, mesh_shape: Dict[str, int],
+                        build_strategy=None, zero: bool = False,
+                        rules: Optional[ShardingRules] = None
+                        ) -> Tuple[Dict[str, tuple], tuple]:
+    """Derive the per-param spec assignment a ``BuildStrategy`` implies,
+    as plain metadata (no devices touched): the input to
+    ``analysis.sharding_check`` and ``Program.memory_plan(mesh=...)``.
+
+    Returns ``(param_specs, feed_spec)`` — ``param_specs`` maps var name
+    to a spec tuple (only sharded vars listed), ``feed_spec`` is the
+    batch-axis spec for feeds. ``zero=True`` (or a build_strategy with
+    ``ReduceStrategy.Reduce``) applies the ZeRO-1 optimizer-state layout;
+    ``rules`` layers name-pattern tensor-parallel specs on top (the
+    ``ShardingRules`` the tp path uses)."""
+    dp = int(mesh_shape.get("dp", 1))
+    if build_strategy is not None:
+        zero = zero or getattr(build_strategy, "reduce_strategy", 0) == 1
+    specs: Dict[str, tuple] = {}
+    for blk in program.blocks:
+        for v in blk.vars.values():
+            if not v.persistable or v.is_data:
+                continue
+            spec: tuple = ()
+            if rules is not None:
+                p = rules.spec_for_param(v.name, v.shape)
+                spec = tuple(p) if tuple(p) else ()
+                if ".pp_stacked" in v.name and "pp" in mesh_shape:
+                    spec = ("pp",)
+            if not any(a is not None for a in spec):
+                spec = zero1_spec_for(v, dp, zero)
+            if any(a is not None for a in spec):
+                specs[v.name] = spec
+    feed_spec = ("dp",) if dp > 1 else ()
+    return specs, feed_spec
 
 
 def place_state(scope_values: Dict[str, "jax.Array"], mesh: Mesh,

@@ -6,8 +6,13 @@
 logit at scale is routine) killed a run that a human would have shrugged
 through. The policy ladder:
 
-* ``raise`` (default, the pre-resilience behavior): write the step's
-  outputs back (the inputs were donated) and raise with op provenance.
+* ``raise`` (default): restore the scope bit-exactly to its pre-step
+  values, then raise with op provenance — catching the error leaves a
+  usable session (the sanitizer never poisons parameters with the nan
+  step's updates). On a path that cannot image pre-step buffers
+  (multi-process global arrays) the step's outputs are written back
+  instead (the inputs were donated; without the write-back the scope
+  would reference deleted buffers).
 * ``skip``: DROP the step — the scope is rolled back bit-exactly to its
   pre-step values and training continues. Because the executor donates
   parameter buffers (the liveness-proven in-place update from PR 2), the
@@ -49,11 +54,16 @@ def policy() -> str:
 
 
 def rollback_active() -> bool:
-    """True when the executor must preserve pre-step donated buffers (any
-    policy that can drop a step instead of raising)."""
+    """True when the executor must preserve pre-step donated buffers:
+    whenever the sanitizer is on. ``skip``/``zero_grad`` need the image to
+    drop the step; ``raise`` needs it so the raise restores pre-step state
+    instead of leaving nan-poisoned parameters in the scope."""
     from ..flags import flag
 
-    return flag("check_nan_inf") and policy() != "raise"
+    if not flag("check_nan_inf"):
+        return False
+    policy()  # validate eagerly: a typo'd policy fails the step, not the trip
+    return True
 
 
 def record_skip(path: str, label: str, exe=None) -> None:

@@ -25,8 +25,10 @@ def main():
     else:
         import jax
 
+        from paddle_tpu.distributed import force_cpu_device_count
+
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 2)
+        force_cpu_device_count(2)
 
     import paddle_tpu as fluid
 
@@ -96,6 +98,16 @@ def main():
             losses.append(float(np.asarray(lv).reshape(-1)[0]))
     if rank == 0:
         print("LOSSES " + json.dumps(losses), flush=True)
+    if nranks > 1:
+        # hard-exit teardown: this jax build's gloo transport double-frees
+        # nondeterministically when interpreter teardown (or even
+        # jax.distributed.shutdown) runs its destructors against the XLA
+        # CPU client. The ranks are already synchronized by the final
+        # training collective; skip every destructor and leave the
+        # coordination sockets to die with the process.
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
     return 0
 
 

@@ -81,12 +81,14 @@ def test_check_nan_inf_off_does_not_raise():
 
 def test_check_nan_inf_keeps_scope_usable_after_error():
     """Review regression: inputs are donated — after a sanitizer error the
-    scope must hold the step's outputs, not deleted buffers."""
+    scope must be restored to usable pre-step values, not deleted (or
+    nan-poisoned) buffers. log(h*h) keeps the clean-input leg finite for
+    any sign of the restored weights; the nan feed trips the sanitizer."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         x = fluid.layers.data("x", shape=[4], dtype="float32")
         h = fluid.layers.fc(x, 4, name="f")
-        out = fluid.layers.mean(fluid.layers.log(h))
+        out = fluid.layers.mean(fluid.layers.log(h * h))
         fluid.optimizer.SGD(0.1).minimize(out)
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.Scope()
@@ -94,10 +96,15 @@ def test_check_nan_inf_keeps_scope_usable_after_error():
     try:
         with fluid.scope_guard(scope):
             exe.run(startup)
+            w0 = np.array(scope.find_var("f.w_0"))
             with pytest.raises(FloatingPointError):
-                exe.run(main, feed={"x": -np.ones((2, 4), np.float32)},
+                exe.run(main,
+                        feed={"x": np.full((2, 4), np.nan, np.float32)},
                         fetch_list=[out.name])
-            # the session must still run with clean input
+            # the nan step's (poisoned) update must NOT have been applied
+            w1 = np.array(scope.find_var("f.w_0"))
+            assert np.array_equal(w0, w1)
+            # the session must still run — and train — with clean input
             (v,) = exe.run(main, feed={"x": np.ones((2, 4), np.float32) * 9},
                            fetch_list=[out.name])
         assert np.isfinite(v).all()
