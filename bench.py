@@ -54,24 +54,15 @@ def _device():
 
 def time_chained(exe, program, feed, fetch_list, scope,
                  k_short=2, k_long=10, repeats=3):
-    """Seconds per step by the chained protocol (module docstring)."""
-    def run_k(k):
-        # first call compiles + warms; timed calls chain through the scope
-        # state (donated buffers), final np.asarray is the host sync
-        out = exe.run_chained(program, feed=feed, fetch_list=fetch_list,
-                              steps=k, scope=scope, return_numpy=False)
-        _ = float(np.asarray(out[0]).reshape(-1)[-1])
-        ts = []
-        for _i in range(repeats):
-            t0 = time.perf_counter()
-            out = exe.run_chained(program, feed=feed, fetch_list=fetch_list,
-                                  steps=k, scope=scope, return_numpy=False)
-            _ = float(np.asarray(out[0]).reshape(-1)[-1])
-            ts.append(time.perf_counter() - t0)
-        return min(ts)
+    """Seconds per step by the chained protocol (module docstring),
+    through the one shared implementation (tuning.chained_step_seconds) —
+    bench, xla_sweep, fusion_check and measure_candidates must stay
+    number-comparable."""
+    from paddle_tpu import tuning
 
-    t_short, t_long = run_k(k_short), run_k(k_long)
-    return (t_long - t_short) / (k_long - k_short)
+    return tuning.chained_step_seconds(exe, program, feed, fetch_list,
+                                       scope, k_short=k_short,
+                                       k_long=k_long, repeats=repeats)
 
 
 def bench_resnet_train(amp: bool, batch=128, k_short=2, k_long=10):
@@ -96,7 +87,16 @@ def bench_resnet_train(amp: bool, batch=128, k_short=2, k_long=10):
     return batch / dt  # img/s
 
 
-def bench_resnet_infer(amp: bool, batch=128, k_short=4, k_long=20):
+def bench_resnet_infer(amp: bool, batch=128, k_short=4, k_long=20,
+                       fused: bool = False):
+    """NOTE on the trajectory (docs/PERF_NOTES.md "The r05 infer
+    discontinuity"): r03/r04 infer numbers timed pipelined async
+    dispatches; r05 switched to the chained scan but the anti-hoisting
+    chain did not engage for for_test programs whose only carried state is
+    identity-written batch_norm statistics, so XLA could hoist the body
+    and the differenced per-step time was unsound. The chain now engages
+    for every non-training program — numbers from this round on are
+    serialized per-step compute and NOT comparable to r03-r05."""
     import jax
 
     import paddle_tpu as fluid
@@ -117,10 +117,61 @@ def bench_resnet_infer(amp: bool, batch=128, k_short=4, k_long=20):
             "label": jax.device_put(
                 rng.randint(0, 1000, (batch, 1)).astype(np.int64), dev)}
     logits = model["logits"].name
-    with fluid.scope_guard(scope):
-        exe.run(model["startup"])
-        dt = time_chained(exe, infer, feed, [logits], scope, k_short, k_long)
+    prev = fluid.get_flags(["FLAGS_epilogue_fusion"])
+    fluid.set_flags({"FLAGS_epilogue_fusion": fused})
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(model["startup"])
+            dt = time_chained(exe, infer, feed, [logits], scope,
+                              k_short, k_long)
+    finally:
+        fluid.set_flags(prev)
     return dt * 1e3  # ms/batch
+
+
+def bench_bert_infer(batch=32, seq_len=512, k_short=2, k_long=8,
+                     fused: bool = False):
+    """BERT-base forward-only (the epilogue-fusion showcase: every
+    q/k/v/out projection and FFN layer carries a mul+bias(+gelu) chain).
+    ``fused=True`` runs the identical program under FLAGS_epilogue_fusion
+    so the BENCH trajectory records the fused-vs-unfused win per round."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models.bert import BertConfig, build_bert_pretrain
+
+    cfg = BertConfig.base()
+    model = build_bert_pretrain(cfg, seq_len=seq_len, amp=True,
+                                build_optimizer=False)
+    infer = model["main"].clone(for_test=True)
+    exe = fluid.Executor(fluid.TPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    dev = _device()
+    feed = {
+        "src_ids": rng.randint(0, cfg.vocab_size, (batch, seq_len)),
+        "pos_ids": np.tile(np.arange(seq_len), (batch, 1)),
+        "sent_ids": np.zeros((batch, seq_len)),
+        "input_mask": np.ones((batch, seq_len), np.float32),
+        "mask_label": np.full((batch, seq_len), -100),
+        "next_sent_label": rng.randint(0, 2, (batch, 1)),
+    }
+    feed["mask_label"][:, ::7] = rng.randint(
+        0, cfg.vocab_size, feed["mask_label"][:, ::7].shape)
+    for k in ("src_ids", "pos_ids", "sent_ids", "mask_label",
+              "next_sent_label"):
+        feed[k] = feed[k].astype(np.int64)
+    feed = {k: jax.device_put(v, dev) for k, v in feed.items()}
+    prev = fluid.get_flags(["FLAGS_epilogue_fusion"])
+    fluid.set_flags({"FLAGS_epilogue_fusion": fused})
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(model["startup"])
+            dt = time_chained(exe, infer, feed, [model["loss"].name],
+                              scope, k_short, k_long)
+    finally:
+        fluid.set_flags(prev)
+    return dt  # s/batch
 
 
 def bench_bert_train(batch=32, seq_len=512, k_short=2, k_long=8,
@@ -205,6 +256,18 @@ def main():
                          lambda: bench_resnet_train(amp=True))
     infer_bf16_ms = section("resnet50_infer_bf16",
                             lambda: bench_resnet_infer(amp=True))
+    # fused legs (FLAGS_epilogue_fusion): the MFU-gap round's win, recorded
+    # per trajectory point. Training legs stay unfused BY DESIGN — the
+    # fusion pass refuses backward-carrying programs (grad ops read the
+    # epilogue intermediates); extra["fusion"] records that refusal
+    # honestly instead of timing a no-op leg.
+    infer_fused_ms = section("resnet50_infer_bf16_fused",
+                             lambda: bench_resnet_infer(amp=True,
+                                                        fused=True))
+    bert_infer_s = section("bert_base_infer_bf16",
+                           lambda: bench_bert_infer(fused=False))
+    bert_infer_fused_s = section("bert_base_infer_bf16_fused",
+                                 lambda: bench_bert_infer(fused=True))
     bert = section("bert", bench_bert_train)
     # the leg r5 said we could not reach: bs=64 needs auto-remat to fit
     # the 16 GB chip (bs=32 peak ~2x'd by doubling the batch)
@@ -219,6 +282,27 @@ def main():
     if infer_bf16_ms is not None:
         extra["resnet50_infer_bs128_bf16_ms"] = round(infer_bf16_ms, 2)
         extra["ref_v100_fp16_infer_bs128_ms"] = REF_FP16_INFER_MS
+        # r03-r05 infer values are NOT comparable: two generations of
+        # broken serialization (async-dispatch pipelining, then a hoisted
+        # scan body) — docs/PERF_NOTES.md "The r05 infer discontinuity"
+        extra["infer_protocol"] = (
+            "chained-v2: anti-hoisting chain forced for all non-training "
+            "programs; r03-r05 infer points measured hoisted/pipelined "
+            "bodies and are not comparable")
+    if infer_fused_ms is not None:
+        extra["resnet50_infer_bs128_bf16_fused_ms"] = round(infer_fused_ms,
+                                                            2)
+        if infer_bf16_ms:
+            extra["resnet50_infer_fused_speedup"] = round(
+                infer_bf16_ms / infer_fused_ms, 3)
+    if bert_infer_s is not None:
+        extra["bert_base_infer_bf16_ms"] = round(bert_infer_s * 1e3, 1)
+    if bert_infer_fused_s is not None:
+        extra["bert_base_infer_bf16_fused_ms"] = round(
+            bert_infer_fused_s * 1e3, 1)
+        if bert_infer_s:
+            extra["bert_infer_fused_speedup"] = round(
+                bert_infer_s / bert_infer_fused_s, 3)
     monitor.remove_hook(hook)
     extra["monitor"] = {
         "compiles": len(compile_log),
@@ -302,6 +386,38 @@ def main():
     # memory trajectory (this round on): auto-remat activity + the memory
     # planner's predicted peaks for the last transformed program (the bs=64
     # BERT leg), so BENCH_*.json tracks memory alongside throughput
+    # epilogue-fusion + autotuner trajectory: chains fused per epilogue
+    # kind during the fused legs, plus the documented training-program
+    # refusal (static, no timing cost)
+    def _fusion_section():
+        import paddle_tpu.unique_name as un
+        from paddle_tpu.analysis.epilogue_fusion import fuse_epilogues
+        from paddle_tpu.models.resnet import build_resnet
+
+        fam = monitor.get_registry().to_dict().get(
+            "fusion_ops_fused_total", {})
+        by_kind = {v["labels"].get("epilogue", "?"): int(v["value"])
+                   for v in fam.get("values", ())}
+        with un.guard():
+            train = build_resnet(depth=50, class_num=1000, amp=True)
+        dec = fuse_epilogues(train["main"],
+                             fetch_names=[train["loss"].name])
+        return {
+            "programs_applied": int(monitor.metric_value(
+                "fusion_programs_total", outcome="applied") or 0),
+            "programs_refused": int(monitor.metric_value(
+                "fusion_programs_total", outcome="refused") or 0),
+            "chains_by_epilogue": by_kind,
+            "train_program_decision": {"applied": dec.applied,
+                                       "reason": dec.reason},
+        }
+
+    section("fusion", lambda: extra.update({"fusion": _fusion_section()}))
+    extra["autotune"] = {
+        "hits": int(monitor.metric_value("autotune_hits_total") or 0),
+        "misses": int(monitor.metric_value("autotune_misses_total") or 0),
+        "trials": int(monitor.metric_value("autotune_trials_total") or 0),
+    }
     extra["remat"] = {
         "programs_applied": int(monitor.metric_value(
             "remat_programs_total", outcome="applied") or 0),

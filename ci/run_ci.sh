@@ -66,6 +66,19 @@ echo "== XLA compile-option sweep (FLAGS_xla_options plumbing; ranked JSON)"
 JAX_PLATFORMS=cpu python tools/xla_sweep.py --ci \
   --json "${CI_ARTIFACT_DIR:-.}/ci_xla_sweep.json" | tail -4
 
+echo "== epilogue-fusion + persistent-autotuner gate (analysis/epilogue_fusion,"
+echo "   paddle_tpu.tuning: fused MLP/BERT-tiny/ResNet-tiny legs must match"
+echo "   unfused bit-exactly on the dense route and not be slower on the"
+echo "   chained-scan protocol; fused programs stay lint-clean; autotune"
+echo "   round-trip: a measure subprocess populates the cost DB, a FRESH"
+echo "   use-mode subprocess compiles straight to the best config with zero"
+echo "   re-trials)"
+JAX_PLATFORMS=cpu python tools/fusion_check.py --check \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_fusion_report.json" | tail -8
+echo "== fusion kill-switch control (FLAGS_epilogue_fusion=0 must show zero"
+echo "   fused ops and a bit-exact baseline)"
+JAX_PLATFORMS=cpu python tools/fusion_check.py --negative-control | tail -3
+
 echo "== chaos gate (paddle_tpu.resilience: kill-mid-checkpoint + transient"
 echo "   compile faults must resume from the last verified checkpoint)"
 JAX_PLATFORMS=cpu python tools/chaos_check.py --check \

@@ -41,6 +41,7 @@ from . import regularizer
 from . import resilience
 from . import serving
 from . import analysis
+from . import tuning
 from .core import registry as op_registry
 from .flags import get_flags, set_flags
 from .layers import learning_rate_scheduler  # registers fluid.layers.* decays

@@ -31,7 +31,7 @@ import numpy as np
 from . import monitor as _monitor
 from . import trace as _trace
 from .core.types import np_dtype
-from .framework import Program, Variable, default_main_program
+from .framework import OpRole, Program, Variable, default_main_program
 from .lowering import LowerCtx, lower_block, lower_op
 from .profiler import RecordEvent
 from .resilience import distributed as _dist
@@ -291,7 +291,7 @@ def analyze_block_io(block, feed_names: set, fetch_names) -> dict:
 
 
 def make_step_fn(block, io: dict, fetch_names, mesh=None,
-                 nan_check_meta=None):
+                 nan_check_meta=None, gemm_blocks=None):
     """The traced step body shared by all execution paths.
 
     ``nan_check_meta``: pass a list to enable FLAGS_check_nan_inf — at trace
@@ -308,7 +308,7 @@ def make_step_fn(block, io: dict, fetch_names, mesh=None,
         checks = None if nan_check_meta is None else []
         ctx = LowerCtx(base_key=rng_key, mesh=mesh,
                        program=getattr(block, "program", None),
-                       nan_checks=checks)
+                       nan_checks=checks, gemm_blocks=gemm_blocks)
         lower_block(block, env, ctx)
         fetches = [env[n] for n in fetch_names]
         new_state = [env[n] for n in io["state_out"]]
@@ -369,7 +369,7 @@ def unpack_step_result(step, result, scope, to_host=np.asarray, *,
 
 
 def make_pipeline_step_fn(block, io: dict, fetch_names, mesh=None,
-                          nan_check_meta=None):
+                          nan_check_meta=None, gemm_blocks=None):
     """Microbatched step (PipelineOptimizer): the forward+backward ops run
     under a lax.scan over ``M`` microbatch slices of every feed,
     accumulating the parameter gradients; the optimize/lr ops then run ONCE
@@ -427,7 +427,7 @@ def make_pipeline_step_fn(block, io: dict, fetch_names, mesh=None,
             env.update(st)
             env.update(zip(io["feed_order"], slices))
             ctx = LowerCtx(base_key=key, mesh=mesh, program=program,
-                           nan_checks=None)
+                           nan_checks=None, gemm_blocks=gemm_blocks)
             for op in fb_ops:
                 lower_op(op, env, ctx)
             new_acc = [a + env[g] for a, g in zip(acc, grad_names)]
@@ -457,7 +457,7 @@ def make_pipeline_step_fn(block, io: dict, fetch_names, mesh=None,
                 checks.append((f"carried state '{n}' (microbatch scan)",
                                jnp.isfinite(v).all()))
         ctx = LowerCtx(base_key=rng_key, mesh=mesh, program=program,
-                       nan_checks=checks)
+                       nan_checks=checks, gemm_blocks=gemm_blocks)
         for op in tail_ops:
             lower_op(op, env, ctx)
         fetches = [fetched[n][-1] if n in fetched else env[n]
@@ -496,6 +496,19 @@ class Executor:
         # The transformed program is a fresh Program with its own _serial,
         # so step-cache keys can never alias remat and plain variants.
         self._remat_cache: Dict[tuple, Program] = {}
+        # FLAGS_epilogue_fusion: (program fingerprint, fetch tuple) ->
+        # fused program (or the original when the pass refused). Fused
+        # programs are fresh clones with their own _serial — cache
+        # separation from the plain variant is structural.
+        self._fusion_cache: Dict[tuple, Program] = {}
+        # the FusionDecision behind each pipeline-run _fusion_cache entry
+        # (pass-through entries have none): lets tools read what the
+        # executor decided without re-running the pass's eager witness
+        self._fusion_decisions: Dict[tuple, Any] = {}
+        # FLAGS_autotune=use|measure: (program fingerprint, bucket, mode)
+        # -> best-known TunedConfig or None; one DB probe per program,
+        # not per step (a fresh process re-reads the database)
+        self._tuning_cache: Dict[tuple, Any] = {}
         # guards the three caches + the seed counter: the serving engine
         # runs this executor from its dispatch thread while the owning
         # thread may still call run() — an unguarded dict resize mid-probe
@@ -556,6 +569,118 @@ class Executor:
             self._remat_cache[key] = decision.program
             return decision.program
 
+    def _maybe_epilogue_fusion(self, program, feed, fetch_names,
+                               tuning_program=None):
+        """FLAGS_epilogue_fusion entry shared by run / run_chained: swap a
+        forward-only program for its GEMM-epilogue-fused rewrite
+        (analysis/epilogue_fusion.py). Training programs, programs with no
+        mul/matmul, and anything the pass's fidelity witness cannot prove
+        pass through untouched. Decisions are cached per (program, fetch
+        list, tuned gemm blocks) — the blocks the compile will thread into
+        its LowerCtx are part of the witnessed configuration, so a cost-DB
+        update re-witnesses; the fused clone has its own _serial so
+        compiled-step caches never alias fused and plain variants.
+        ``tuning_program`` is the SUBMITTED program the compile path keys
+        the cost database on."""
+        from .flags import flag
+
+        if not flag("epilogue_fusion") or not isinstance(program, Program):
+            return program
+        _, _, gemm_blocks = self._tuned_compile_config(
+            tuning_program if isinstance(tuning_program, Program)
+            else program, feed)
+        key = (self._program_fingerprint(program),
+               tuple(fetch_names or ()), gemm_blocks)
+        with self._lock:
+            cached = self._fusion_cache.get(key)
+        if cached is not None:
+            return cached
+        from .analysis.epilogue_fusion import has_fusable_ops
+
+        # training programs / no matmul: pass through (cached) with no
+        # monitor record — a 'refused' count here would read as a
+        # fusable program the pass could not handle
+        if not has_fusable_ops(program):
+            with self._lock:
+                self._fusion_cache.setdefault(key, program)
+            return program
+        from .analysis.pass_manager import run_transform_pipeline
+
+        # the pipeline's fidelity witness eagerly executes jax
+        # computations per chain signature — run it OUTSIDE the executor
+        # lock (run/run_chained/serving dispatch all contend on it) and
+        # insert first-wins, like the compiled-step double-check: two
+        # racing threads must converge on ONE fused clone, or its _serial
+        # would split the compiled-step caches
+        result = run_transform_pipeline(
+            program, ("epilogue_fusion",),
+            feed_names=sorted(feed or {}),
+            fetch_names=list(fetch_names or ()),
+            batch_size=_feed_batch_rows(feed),
+            options={"gemm_blocks": gemm_blocks})
+        decision = result.values["epilogue_fusion"]
+        with self._lock:
+            winner = self._fusion_cache.get(key)
+            if winner is None:
+                winner = self._fusion_cache[key] = decision.program
+                self._fusion_decisions[key] = decision
+                record = True
+            else:
+                record = False
+        if record:
+            _monitor.record_fusion(decision)
+        return winner
+
+    def _tuned_compile_config(self, program, feed):
+        """(xla_options dict, sorted key tuple, gemm blocks or None) for
+        one compile: explicit FLAGS_xla_options / FLAGS_fused_gemm_blocks
+        always win; with FLAGS_autotune=use|measure the cost database
+        fills whichever knob is unset (paddle_tpu.tuning), and the chosen
+        values join every compile-cache key so a database update
+        recompiles instead of silently reusing a stale executable."""
+        from .flags import flag, xla_options
+
+        opts = xla_options()
+        # an explicitly-set FLAGS_xla_options='{}' means "no options, on
+        # purpose" — it must win over the DB like any other explicit value
+        opts_explicit = bool(str(flag("xla_options")).strip())
+        blocks = None
+        if str(flag("fused_gemm_blocks")).strip():
+            from .ops.fused_gemm import resolve_gemm_blocks
+
+            blocks = resolve_gemm_blocks(None)
+        if (not opts and not opts_explicit) or blocks is None:
+            from . import tuning
+
+            mode = tuning.autotune_mode()
+            # never fill knobs DURING a measure_candidates trial: the
+            # candidate under test must compile exactly as specified, or
+            # its time is recorded against the wrong config
+            if mode != "off" and not tuning.in_trial() \
+                    and isinstance(program, Program):
+                batch = _feed_batch_rows(feed)
+                tkey = (self._program_fingerprint(program),
+                        tuning.shape_bucket(batch), mode)
+                with self._lock:
+                    probed = tkey in self._tuning_cache
+                    cfg = self._tuning_cache.get(tkey)
+                if not probed:
+                    cfg = tuning.lookup_best(program, batch)
+                    with self._lock:
+                        self._tuning_cache[tkey] = cfg
+                if cfg is not None:
+                    if not opts and not opts_explicit:
+                        opts = cfg.options_dict()
+                    if blocks is None and cfg.gemm_blocks:
+                        blocks = cfg.gemm_blocks
+        # the blocks tuple is threaded into the step fn's LowerCtx by the
+        # caller (never stamped on the shared Program): the values the
+        # fused_gemm_epilogue lowering traces with are exactly the values
+        # in this compile's cache key, even when concurrent compiles of
+        # the same program resolve different tuned configs
+        return opts, tuple(sorted(opts.items())), \
+            tuple(blocks) if blocks else None
+
     def _verify_once(self, program: Program, fetch_names) -> None:
         """FLAGS_check_program pre-run hook: static-verify each program
         version once before it compiles (the build-time role of the
@@ -607,7 +732,10 @@ class Executor:
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in (fetch_list or [])]
 
+        submitted = program
         program = self._maybe_auto_remat(program, feed, fetch_names)
+        program = self._maybe_epilogue_fusion(program, feed, fetch_names,
+                                              tuning_program=submitted)
         self._verify_once(program, fetch_names)
         mrec = _monitor.step_begin("run", program)
         # child of whatever request/step trace is ambient on this thread
@@ -616,16 +744,18 @@ class Executor:
                          program=int(getattr(program, "_serial", -1))):
             try:
                 return self._run_body(program, feed, fetch_names, scope,
-                                      return_numpy, use_program_cache, mrec)
+                                      return_numpy, use_program_cache, mrec,
+                                      tuning_program=submitted)
             finally:
                 # always paired with step_begin — a step that raises (e.g.
                 # FLAGS_check_nan_inf) still counts and hooks stay in sync
                 _monitor.step_end(mrec)
 
     def _run_body(self, program, feed, fetch_names, scope, return_numpy,
-                  use_program_cache, mrec):
+                  use_program_cache, mrec, tuning_program=None):
         step = self._get_compiled(program, feed, fetch_names, scope,
-                                  use_cache=use_program_cache, mrec=mrec)
+                                  use_cache=use_program_cache, mrec=mrec,
+                                  tuning_program=tuning_program)
         if mrec is not None:
             mrec.fetch_names = tuple(fetch_names)
             mrec.feed_bytes = sum(_feed_host_bytes(v) for v in feed.values())
@@ -757,15 +887,21 @@ class Executor:
                 "run_chained with PipelineOptimizer programs: the pipeline "
                 "step is already a scan; nest via GradientMergeOptimizer")
 
+        submitted = program
         program = self._maybe_auto_remat(program, feed, fetch_names)
+        program = self._maybe_epilogue_fusion(program, feed, fetch_names,
+                                              tuning_program=submitted)
         self._verify_once(program, fetch_names)
-        from .flags import xla_options
-
-        xla_opts = tuple(sorted(xla_options().items()))
+        # tuning keys on the SUBMITTED program: measure_candidates records
+        # trials under its content fingerprint, before the auto-remat /
+        # fusion clones (whose fingerprints differ) are swapped in
+        opts, xla_opts, gemm_blocks = self._tuned_compile_config(submitted,
+                                                                 feed)
         feed_sig = tuple(sorted(
             (n,) + _shape_dtype_sig(v) for n, v in feed.items()))
         key = ("chained", self._program_fingerprint(program), feed_sig,
-               tuple(fetch_names), int(steps), scope._serial, xla_opts)
+               tuple(fetch_names), int(steps), scope._serial, xla_opts,
+               gemm_blocks)
         with self._lock:
             step = self._cache.get(key)
         mrec = _monitor.step_begin("chained", program)
@@ -782,20 +918,23 @@ class Executor:
             try:
                 return self._run_chained_body(program, feed, fetch_names,
                                               steps, scope, return_numpy,
-                                              key, step, feed_sig, mrec)
+                                              key, step, feed_sig, mrec,
+                                              (opts, xla_opts, gemm_blocks))
             finally:
                 _monitor.step_end(mrec)
 
     def _run_chained_body(self, program, feed, fetch_names, steps, scope,
-                          return_numpy, key, step, feed_sig, mrec):
+                          return_numpy, key, step, feed_sig, mrec,
+                          compile_cfg):
         if step is None:
             step = self._build_chained_step(program, feed, fetch_names,
-                                            steps, scope, key, feed_sig)
+                                            steps, scope, key, feed_sig,
+                                            compile_cfg)
         return self._dispatch_chained(program, feed, steps, scope,
                                       return_numpy, step, mrec)
 
     def _build_chained_step(self, program, feed, fetch_names, steps, scope,
-                            key, feed_sig):
+                            key, feed_sig, compile_cfg):
         # under the executor lock with a double-check: a racing thread
         # must reuse the same scan wrapper, not fork a second compile
         with self._lock:
@@ -815,59 +954,112 @@ class Executor:
             carried_set = set(carried)
             ro_names = [n for n in io["ro"] if n not in carried_set]
             io2 = dict(io, donated=carried, ro=ro_names)
-            base_step = make_step_fn(block, io2, fetch_names)
+            base_step = make_step_fn(block, io2, fetch_names,
+                                     gemm_blocks=compile_cfg[2])
             idx = {n: i for i, n in enumerate(io["state_out"])}
             wo_names = [n for n in io["state_out"] if n not in carried_set]
 
-            # Stateless programs (inference clones) have an empty carry, so
-            # XLA's loop-invariant code motion would hoist the whole body out
-            # of the scan and a timing of K iterations would measure ONE.
-            # Feed a runtime-zero perturbation chained off each step's first
-            # fetch into the first float feed: exact results (the scalar IS
-            # zero at runtime), but the compiler cannot prove it, so the
-            # bodies stay serialized. Training programs already chain through
-            # the carried params.
-            needs_chain = not carried
+            # Inference programs would let XLA's loop-invariant code motion
+            # hoist the whole body out of the scan, so a timing of K
+            # iterations would measure ONE. Feed a runtime-zero perturbation
+            # chained off each step's first fetch into the first float feed
+            # (falling back to the smallest float read-only input, then the
+            # smallest float carried input, for feed-less programs like GPT
+            # decode — the source falls back from fetches to the smallest
+            # float carried output): exact results (the scalar IS zero at
+            # runtime), but the compiler cannot prove it, so the bodies
+            # stay serialized.
+            # The old trigger was `not carried` — which missed for_test
+            # clones whose only carried state is identity-written
+            # batch_norm statistics (use_global_stats writes MeanOut=Mean):
+            # XLA's while-loop simplifier sees the fixed-point carry,
+            # hoists the body, and the chained infer "per-step" time
+            # differences to ~zero (the r03->r05 ResNet-50 infer
+            # discontinuity in the bench trajectory — docs/PERF_NOTES.md
+            # "The r05 infer discontinuity"). Training programs genuinely
+            # chain through the optimizer's parameter updates; everything
+            # else gets the explicit chain.
+            is_training = any(
+                op.attrs.get("__op_role__", OpRole.Forward)
+                != OpRole.Forward for op in block.ops)
+            needs_chain = not is_training
+
+            def _is_float(v) -> bool:
+                return jnp.issubdtype(jnp.result_type(v), jnp.inexact)
+
+            def _smallest_float_i(vals):
+                cands = [(v.size, i) for i, v in enumerate(vals)
+                         if _is_float(v) and v.size]
+                return min(cands)[1] if cands else None
 
             def multi_fn(feed_vals, donated_vals, kept_vals, ro_vals, keys,
                          wo_init, chain_eps):
-                float_i = next(
-                    (i for i, v in enumerate(feed_vals)
-                     if jnp.issubdtype(jnp.result_type(v), jnp.inexact)),
-                    None) if needs_chain else None
+                # perturbation target: float feed first (the original
+                # protocol), else the SMALLEST float ro / carried input so
+                # a feed-less decode program pays one tiny add per step,
+                # not a KV-cache-sized one
+                float_i = ro_i = carry_i = None
                 carried_init = list(donated_vals) + list(kept_vals)
+                if needs_chain:
+                    float_i = next((i for i, v in enumerate(feed_vals)
+                                    if _is_float(v)), None)
+                    if float_i is None:
+                        ro_i = _smallest_float_i(ro_vals)
+                    if float_i is None and ro_i is None:
+                        carry_i = _smallest_float_i(carried_init)
+                chained = (float_i is not None or ro_i is not None
+                           or carry_i is not None)
 
                 def body(carry, k):
                     cur, _, s = carry
                     fv = list(feed_vals)
+                    rv = ro_vals
+                    cv = cur
                     if float_i is not None:
                         fv[float_i] = fv[float_i] + (
                             chain_eps * s).astype(fv[float_i].dtype)
-                    fetches, new_state = base_step(fv, cur, ro_vals, k)
+                    elif ro_i is not None:
+                        rv = list(ro_vals)
+                        rv[ro_i] = rv[ro_i] + (
+                            chain_eps * s).astype(rv[ro_i].dtype)
+                    elif carry_i is not None:
+                        cv = list(cur)
+                        cv[carry_i] = cv[carry_i] + (
+                            chain_eps * s).astype(cv[carry_i].dtype)
+                    fetches, new_state = base_step(fv, cv, rv, k)
                     new_carried = [new_state[idx[n]] for n in carried]
                     new_wo = [new_state[idx[n]] for n in wo_names]
                     s_next = s
-                    if float_i is not None:
-                        for f in fetches:
-                            if jnp.issubdtype(jnp.result_type(f),
-                                              jnp.inexact):
-                                s_next = f.ravel()[0].astype(jnp.float32)
-                                break
+                    if chained:
+                        # chain source: first float fetch (the original
+                        # protocol), else any non-empty fetch (int token
+                        # ids chain just as well — they depend on the
+                        # perturbed input), else the smallest float
+                        # carried output
+                        src = next((f for f in fetches
+                                    if _is_float(f) and f.size), None)
+                        if src is None:
+                            src = next((f for f in fetches if f.size),
+                                       None)
+                        if src is None:
+                            j = _smallest_float_i(new_carried)
+                            src = new_carried[j] if j is not None else None
+                        if src is not None:
+                            s_next = src.ravel()[0].astype(jnp.float32)
                     return (new_carried, new_wo, s_next), fetches
 
                 (fin_carried, fin_wo, _), stacked = jax.lax.scan(
                     body, (carried_init, wo_init, jnp.float32(0)), keys)
                 return stacked, fin_carried, fin_wo
 
-            from .flags import xla_options
-
-            opts = xla_options()
+            opts, xla_opts, gemm_blocks = compile_cfg
             jitted = jax.jit(multi_fn, donate_argnums=(1,),
                              compiler_options=opts or None)
             step = _CompiledStep(jitted, io["feed_order"], io["donated"],
                                  ro_names, io["state_out"],
                                  tuple(fetch_names))
             step.program = program
+            step.needs_chain = needs_chain
             step._compile_event = _monitor.observe_compile(
                 "chained", program,
                 components={
@@ -876,7 +1068,8 @@ class Executor:
                     "fetch_list": tuple(fetch_names),
                     "scope": scope._serial,
                     "steps": int(steps),
-                    "xla_options": tuple(sorted(opts.items())),
+                    "xla_options": xla_opts,
+                    "gemm_blocks": gemm_blocks,
                 },
                 donated_names=io["donated"])
             step.kept_names = kept
@@ -913,26 +1106,35 @@ class Executor:
             step.wo_shapes = [(out_shapes[1][wo_idx[n]].shape,
                                out_shapes[1][wo_idx[n]].dtype)
                               for n in step.wo_names]
-            if not step.carried_names:
-                # stateless program: the anti-hoisting chain (see multi_fn)
-                # needs a float feed to perturb AND a float fetch to carry;
-                # without both, XLA hoists the loop-invariant body and a
-                # timing of K steps measures ONE — warn loudly rather than
-                # let a benchmark silently report K x real throughput
-                has_float_feed = any(
-                    jnp.issubdtype(jnp.result_type(v), jnp.inexact)
-                    for v in feed_vals)
-                has_float_fetch = any(
-                    jnp.issubdtype(s.dtype, jnp.inexact)
-                    for s in out_shapes[0])
-                if not (has_float_feed and has_float_fetch):
+            if getattr(step, "needs_chain", not step.carried_names):
+                # chained measurement honesty: the anti-hoisting chain (see
+                # multi_fn) needs a float input to perturb (feed, or for
+                # feed-less programs like GPT decode a read-only/carried
+                # input) AND a non-empty output to carry the chain through
+                # (any fetch, or a float carried output); without both, XLA
+                # hoists the loop-invariant body and a timing of K steps
+                # measures ONE — warn loudly rather than let a benchmark
+                # silently report K x real throughput
+                def _inexact(v):
+                    return jnp.issubdtype(jnp.result_type(v), jnp.inexact)
+
+                can_perturb = any(
+                    _inexact(v) for v in feed_vals) or any(
+                    _inexact(v) and v.size
+                    for v in ro_vals + donated_vals + kept_vals)
+                can_carry = any(
+                    s.size for s in out_shapes[0]) or any(
+                    _inexact(v) and v.size
+                    for v in donated_vals + kept_vals)
+                if not (can_perturb and can_carry):
                     import warnings
 
                     warnings.warn(
-                        "run_chained: program has no trainable state, no "
-                        "float feed/fetch pair to chain iterations through "
-                        "— XLA may hoist the body and execute it ONCE; do "
-                        "not use this timing as a per-step measurement",
+                        "run_chained: program has no trainable state and "
+                        "no float input / non-empty output pair to chain "
+                        "iterations through — XLA may hoist the body and "
+                        "execute it ONCE; do not use this timing as a "
+                        "per-step measurement",
                         RuntimeWarning, stacklevel=3)
         wo_init = [jnp.zeros(s, d) for s, d in step.wo_shapes]
         # step-site fault probe fires BEFORE donation, scope stays usable
@@ -1018,6 +1220,9 @@ class Executor:
             self._cache.clear()
             self._verified.clear()
             self._remat_cache.clear()
+            self._fusion_cache.clear()
+            self._fusion_decisions.clear()
+            self._tuning_cache.clear()
 
     # -- internals -------------------------------------------------------
     def _next_seed(self, program: Program) -> int:
@@ -1055,16 +1260,23 @@ class Executor:
                 sum(len(b.ops) for b in program.blocks))
 
     def _get_compiled(self, program, feed, fetch_names, scope,
-                      use_cache: bool = True, mrec=None) -> _CompiledStep:
+                      use_cache: bool = True, mrec=None,
+                      tuning_program=None) -> _CompiledStep:
         feed_sig = tuple(sorted(
             (n,) + _shape_dtype_sig(v) for n, v in feed.items()
         ))
-        from .flags import flag, xla_options
+        from .flags import flag
 
-        xla_opts = tuple(sorted(xla_options().items()))
+        # tuning_program: the program as the CALLER submitted it, before
+        # auto-remat / epilogue-fusion swapped in a rewritten clone.
+        # tuning.measure_candidates records trials under the submitted
+        # program's content fingerprint, so lookups must key on the same
+        # object or a fused program could never reuse its own trials
+        opts, xla_opts, gemm_blocks = self._tuned_compile_config(
+            tuning_program if tuning_program is not None else program, feed)
         key = (self._program_fingerprint(program), feed_sig,
                tuple(fetch_names), scope._serial, flag("check_nan_inf"),
-               xla_opts)
+               xla_opts, gemm_blocks)
         # the whole lookup-or-build runs under the executor lock: two
         # threads racing the same key must share ONE step (and one monitor
         # compile record); _compile only builds the jit wrapper — the
@@ -1079,7 +1291,8 @@ class Executor:
                 return self._cache[key]
             with RecordEvent("executor::build_step"):
                 step = self._compile(program, set(feed.keys()), fetch_names,
-                                     scope)
+                                     scope, xla_opts=opts,
+                                     gemm_blocks=gemm_blocks)
             step.program = program
             step._compile_event = _monitor.observe_compile(
                 "run", program,
@@ -1090,21 +1303,26 @@ class Executor:
                     "scope": scope._serial,
                     "flags": (("check_nan_inf", flag("check_nan_inf")),),
                     "xla_options": xla_opts,
+                    "gemm_blocks": gemm_blocks,
                 },
                 donated_names=step.donated_names)
             self._cache[key] = step
             return step
 
-    def _compile(self, program: Program, feed_names: set, fetch_names, scope):
+    def _compile(self, program: Program, feed_names: set, fetch_names,
+                 scope, xla_opts=None, gemm_blocks=None):
         from .flags import flag, xla_options
 
+        if xla_opts is None:
+            xla_opts = xla_options()
         block = program.global_block
         io = analyze_block_io(block, feed_names, fetch_names)
         meta = [] if flag("check_nan_inf") else None
         step_fn = pick_step_fn(program)(block, io, fetch_names,
-                                        nan_check_meta=meta)
+                                        nan_check_meta=meta,
+                                        gemm_blocks=gemm_blocks)
         jitted = jax.jit(step_fn, donate_argnums=(1,),
-                         compiler_options=xla_options() or None)
+                         compiler_options=xla_opts or None)
         step = _CompiledStep(jitted, io["feed_order"], io["donated"],
                              io["ro"], io["state_out"], tuple(fetch_names))
         step.kept_names = [n for n in io["ro"] if n in io["state_out"]]

@@ -6,7 +6,10 @@ from .flash_attention import (classify_shapes, flash_attention,
                               flash_attention_with_lse, supports_shapes)
 from .decode_attention import (decode_attention_reference,
                                flash_attention_decode, paged_kv_append)
+from .fused_gemm import (classify_gemm, fused_gemm, fused_gemm_reference,
+                         supports_gemm)
 
 __all__ = ["flash_attention", "flash_attention_with_lse", "supports_shapes",
            "classify_shapes", "flash_attention_decode", "paged_kv_append",
-           "decode_attention_reference"]
+           "decode_attention_reference", "fused_gemm", "classify_gemm",
+           "supports_gemm", "fused_gemm_reference"]

@@ -73,7 +73,7 @@ class LowerCtx:
     """Context passed to every op lowering rule."""
 
     def __init__(self, base_key=None, uid: int = 0, mesh=None, axis_env=None,
-                 program=None, nan_checks=None):
+                 program=None, nan_checks=None, gemm_blocks=None):
         self.base_key = base_key
         self.uid = uid
         self.mesh = mesh          # jax.sharding.Mesh when lowering under shard_map
@@ -83,6 +83,12 @@ class LowerCtx:
         # per float op output during the trace; the executor fetches the
         # bools and raises with the label on the first non-finite one
         self.nan_checks = nan_checks
+        # autotuner-chosen fused-GEMM block sizes for THIS compile, bound
+        # at step-fn build time (the same values that sit in the compile
+        # cache key) — a shared per-Program stamp read lazily at trace
+        # time would let a concurrent compile with a different tuned
+        # config leak its blocks into this executable
+        self.gemm_blocks = gemm_blocks
 
     def rng(self):
         """PRNG key unique to this op instance; grad ops fold in the forward
@@ -94,7 +100,7 @@ class LowerCtx:
 
     def with_uid(self, uid: int) -> "LowerCtx":
         return LowerCtx(self.base_key, uid, self.mesh, self.axis_env,
-                        self.program, self.nan_checks)
+                        self.program, self.nan_checks, self.gemm_blocks)
 
 
 def _gather_inputs(op, env: Dict[str, Any]) -> Dict[str, List[Any]]:

@@ -1,0 +1,597 @@
+"""paddle_tpu.tuning — the persistent, measurement-driven autotuner.
+
+``tools/xla_sweep.py`` (the PR 5 one-shot sweep) proved the knobs move
+throughput: ``FLAGS_xla_options`` reaches ``jax.jit(compiler_options=...)``
+on every executor path, and the fused-GEMM kernel's block sizes change its
+tiling. What it lacked was memory — every process re-paid the sweep. This
+package is the TVM lesson ("Learning to Optimize Tensor Programs",
+PAPERS.md arXiv 1805.08166) applied to those knobs: a durable cost
+database keyed by **(program content fingerprint, shape bucket, backend)**
+records measured step time / achieved TF/s per candidate, and the best
+known entry feeds back into the executor compile path automatically.
+
+Modes (``FLAGS_autotune``):
+
+* ``off``     — no database access anywhere (default).
+* ``use``     — ``Executor`` consults the DB at compile time: when
+  ``FLAGS_xla_options`` / ``FLAGS_fused_gemm_blocks`` are not explicitly
+  set, the best-known candidate supplies them (and joins the compile-cache
+  key, so a DB update recompiles rather than silently reusing a stale
+  executable). Zero trials ever run in this mode.
+* ``measure`` — ``use`` plus :func:`measure_candidates` may run trials
+  (the chained-differencing protocol) and record them.
+
+Safety: the executor-path lookups NEVER raise — a torn/corrupt/alien DB
+file degrades to "no best known" with one warning (the same
+flight-recorder-safe posture as the monitor). Entries carry the framework
+and jax versions; ``best()`` ignores trials measured by a different
+version (staleness rule — docs/PERF_NOTES.md "Persistent autotuner").
+
+Counters (docs/OBSERVABILITY.md): ``autotune_hits_total`` /
+``autotune_misses_total`` (compile-path lookups), ``autotune_trials_total``
+(measured candidates), ``autotune_best_per_step_seconds`` gauge per
+(program, bucket).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "CostDatabase", "TunedConfig", "autotune_mode", "default_db_path",
+    "get_database", "program_content_fingerprint", "shape_bucket",
+    "lookup_best", "record_trial", "measure_candidates", "in_trial",
+    "trial_guard", "chained_step_seconds",
+    "CPU_OPTION_SETS", "TPU_OPTION_SETS", "GEMM_BLOCK_SETS",
+]
+
+logger = logging.getLogger("paddle_tpu.tuning")
+
+_SCHEMA = 1
+
+# measure_candidates sets this while a candidate trial is running: the
+# executor compile path must NOT fill unset knobs from the DB during a
+# trial, or the baseline {} candidate (and every candidate's unset
+# gemm_blocks) would silently be measured under the best-known config and
+# recorded as if it were its own — corrupting best() forever after.
+# PROCESS-global (a nesting counter, not thread-local) on purpose: the
+# candidate flags set_flags writes are process-global too, so any OTHER
+# thread compiling mid-sweep (e.g. a serving dispatch sharing the
+# executor) already sees the candidate's explicit flags — at least it
+# must not additionally mix DB-filled knobs into them. Concurrent
+# traffic during a sweep still compiles under transient candidate flags;
+# see the measure_candidates docstring.
+_trial_depth = 0
+_trial_lock = threading.Lock()
+
+
+def in_trial() -> bool:
+    """True while measure_candidates is timing a candidate anywhere in
+    this process (the executor skips DB knob-filling then)."""
+    return _trial_depth > 0
+
+
+class trial_guard:
+    """Context manager marking this process as running a tuning trial:
+    the executor compile path will not fill unset knobs from the cost
+    database while it is active — a candidate must compile exactly as
+    its flags specify (measure_candidates and tools/xla_sweep.py both
+    time under it)."""
+
+    def __enter__(self):
+        global _trial_depth
+        with _trial_lock:
+            _trial_depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _trial_depth
+        with _trial_lock:
+            _trial_depth = max(0, _trial_depth - 1)
+        return False
+
+# candidate sets, moved here from tools/xla_sweep.py (the tool now imports
+# them back): scheduling/fusion knobs that historically move dense-training
+# throughput — swept and measured, never assumed.
+TPU_OPTION_SETS: List[dict] = [
+    {},
+    {"xla_tpu_enable_latency_hiding_scheduler": True},
+    {"xla_enable_async_all_gather": True,
+     "xla_enable_async_collective_permute": True},
+    {"xla_tpu_enable_latency_hiding_scheduler": True,
+     "xla_enable_async_all_gather": True},
+]
+CPU_OPTION_SETS: List[dict] = [
+    {},
+    {"xla_cpu_enable_fast_min_max": True},
+    {"xla_llvm_disable_expensive_passes": True},
+    {"xla_cpu_enable_fast_min_max": True,
+     "xla_llvm_disable_expensive_passes": True},
+]
+# fused-GEMM kernel tilings worth trying when the program carries fused ops
+GEMM_BLOCK_SETS: List[Optional[Tuple[int, int, int]]] = [
+    None,                      # the (128, 128, 128) default
+    (256, 128, 128),
+    (128, 256, 128),
+    (128, 128, 256),
+]
+
+
+def autotune_mode() -> str:
+    from ..flags import flag
+
+    mode = str(flag("autotune")).strip().lower() or "off"
+    if mode not in ("off", "use", "measure"):
+        raise ValueError(f"FLAGS_autotune must be off|use|measure, "
+                         f"got {mode!r}")
+    return mode
+
+
+def default_db_path() -> str:
+    from ..flags import flag
+
+    raw = str(flag("autotune_db")).strip()
+    if raw:
+        return raw
+    return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                        "autotune_db.json")
+
+
+def _versions() -> Tuple[str, str]:
+    import jax
+
+    from .. import __version__
+
+    return str(__version__), str(jax.__version__)
+
+
+def shape_bucket(batch_rows: int) -> int:
+    """Power-of-two batch bucket — the serving engine's padding rule, so a
+    measurement at bucket 128 serves every batch the executor would pad
+    there."""
+    b = max(int(batch_rows), 1)
+    p = 1
+    while p < b:
+        p <<= 1
+    return p
+
+
+_VOLATILE_ATTRS = ("__uid__", "op_callstack", "op_namescope")
+
+
+def program_content_fingerprint(program) -> str:
+    """Stable CONTENT hash of a program — unlike ``program._serial`` (a
+    per-process counter) it survives process restarts, which is what makes
+    the database durable. Hashes op types, slot wiring, non-volatile attrs
+    and var metadata in deterministic order; memoized per (program,
+    version)."""
+    cached = getattr(program, "_content_fp", None)
+    if cached is not None and cached[0] == getattr(program, "_version", 0):
+        return cached[1]
+    h = hashlib.sha256()
+    for blk in program.blocks:
+        for name in sorted(blk.vars):
+            v = blk.vars[name]
+            h.update(f"v|{blk.idx}|{name}|{v.shape}|{v.dtype}|"
+                     f"{v.persistable}|{v.is_data}\n".encode())
+        for op in blk.ops:
+            attrs = sorted((k, repr(val)) for k, val in op.attrs.items()
+                           if k not in _VOLATILE_ATTRS)
+            h.update(f"o|{blk.idx}|{op.type}|"
+                     f"{sorted((k, tuple(v)) for k, v in op.inputs.items())}|"
+                     f"{sorted((k, tuple(v)) for k, v in op.outputs.items())}"
+                     f"|{attrs}\n".encode())
+    fp = h.hexdigest()[:16]
+    try:
+        program._content_fp = (getattr(program, "_version", 0), fp)
+    except Exception:
+        pass
+    return fp
+
+
+@dataclasses.dataclass(frozen=True)
+class TunedConfig:
+    """One candidate configuration (also the DB trial identity)."""
+
+    xla_options: Tuple[Tuple[str, Any], ...] = ()
+    gemm_blocks: Optional[Tuple[int, int, int]] = None
+
+    @staticmethod
+    def make(xla_options: Optional[dict] = None,
+             gemm_blocks=None) -> "TunedConfig":
+        return TunedConfig(
+            xla_options=tuple(sorted((xla_options or {}).items())),
+            gemm_blocks=tuple(int(b) for b in gemm_blocks)
+            if gemm_blocks else None)
+
+    def options_dict(self) -> dict:
+        return dict(self.xla_options)
+
+    def to_dict(self) -> dict:
+        return {"xla_options": dict(self.xla_options),
+                "gemm_blocks": list(self.gemm_blocks)
+                if self.gemm_blocks else None}
+
+    @staticmethod
+    def from_dict(d: dict) -> "TunedConfig":
+        return TunedConfig.make(d.get("xla_options") or {},
+                                d.get("gemm_blocks"))
+
+
+class CostDatabase:
+    """The durable cost store: one JSON file, atomic rewrite (temp sibling
+    + fsync + rename — the checkpoint manifest's publish discipline), a
+    thread lock per instance plus a cross-process file lock + merge-on-save
+    for concurrent recorders (two measure-mode processes sharing one DB
+    union their trials instead of last-writer-wins dropping one side).
+    Load failures are warnings, not errors: a corrupt database means
+    "nothing is known", never a broken run."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.RLock()
+        self._entries: Optional[Dict[str, dict]] = None
+
+    # -- keys ------------------------------------------------------------
+    @staticmethod
+    def key(program_fp: str, bucket: int, backend: str) -> str:
+        return f"{program_fp}|b{int(bucket)}|{backend}"
+
+    # -- persistence -----------------------------------------------------
+    def _read_file(self) -> Dict[str, dict]:
+        """Entries as currently on disk — no memoization."""
+        entries: Dict[str, dict] = {}
+        try:
+            if os.path.exists(self.path):
+                with open(self.path, "r", encoding="utf-8") as f:
+                    raw = json.load(f)
+                if isinstance(raw, dict) and raw.get("schema") == _SCHEMA \
+                        and isinstance(raw.get("entries"), dict):
+                    entries = raw["entries"]
+                else:
+                    logger.warning(
+                        "autotune DB %s has schema %r (want %d) — starting "
+                        "empty", self.path, raw.get("schema")
+                        if isinstance(raw, dict) else None, _SCHEMA)
+        except Exception as e:
+            logger.warning("autotune DB %s unreadable (%s: %s) — starting "
+                           "empty", self.path, type(e).__name__, e)
+        return entries
+
+    def _load(self) -> Dict[str, dict]:
+        if self._entries is None:
+            self._entries = self._read_file()
+        return self._entries
+
+    def _merge_from_disk(self, entries: Dict[str, dict]) -> None:
+        """Union trials another process recorded since we memoized into
+        ``entries``. Same-candidate conflicts keep the in-memory trial
+        (this process re-measured — record()'s latest-belief rule)."""
+        for key, de in self._read_file().items():
+            e = entries.get(key)
+            if e is None:
+                entries[key] = de
+                continue
+            have = {json.dumps(t.get("candidate"), sort_keys=True)
+                    for t in e.get("trials", ())}
+            for t in de.get("trials", ()):
+                if json.dumps(t.get("candidate"),
+                              sort_keys=True) not in have:
+                    e.setdefault("trials", []).append(t)
+
+    def save(self) -> None:
+        with self._lock:
+            entries = self._load()
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            # cross-process exclusive section: lock sibling, re-read,
+            # merge, then the atomic rewrite — concurrent recorders
+            # serialize here instead of last-replace-wins losing trials
+            lk = None
+            try:
+                try:
+                    import fcntl
+
+                    lk = open(self.path + ".lock", "w")
+                    fcntl.flock(lk, fcntl.LOCK_EX)
+                except Exception:
+                    lk = None   # no fcntl (or lockfile unwritable):
+                    # merge-on-save still shrinks the lost-update window
+                self._merge_from_disk(entries)
+                tmp = self.path + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump({"schema": _SCHEMA, "entries": entries}, f,
+                              indent=1, sort_keys=True)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+            finally:
+                if lk is not None:
+                    lk.close()
+
+    # -- record / query --------------------------------------------------
+    def record(self, program_fp: str, bucket: int, backend: str,
+               config: TunedConfig, per_step_s: float,
+               achieved_tflops: Optional[float] = None,
+               save: bool = True) -> dict:
+        fw, jx = _versions()
+        trial = {"candidate": config.to_dict(),
+                 "per_step_s": float(per_step_s),
+                 "achieved_tflops": achieved_tflops,
+                 "framework_version": fw, "jax_version": jx,
+                 "recorded_at": time.time()}
+        with self._lock:
+            entries = self._load()
+            key = self.key(program_fp, bucket, backend)
+            e = entries.setdefault(key, {"program": program_fp,
+                                         "bucket": int(bucket),
+                                         "backend": backend, "trials": []})
+            # one trial per candidate: remeasuring replaces (the DB stores
+            # the latest belief, the artifact JSONs keep the history)
+            cand = config.to_dict()
+            e["trials"] = [t for t in e["trials"]
+                           if t.get("candidate") != cand]
+            e["trials"].append(trial)
+            if save:
+                self.save()
+        return trial
+
+    def best(self, program_fp: str, bucket: int, backend: str
+             ) -> Optional[dict]:
+        """The fastest valid trial, or None. Staleness rule: trials from a
+        different framework or jax version are invisible — a compiler
+        upgrade invalidates its own measurements."""
+        fw, jx = _versions()
+        with self._lock:
+            e = self._load().get(self.key(program_fp, bucket, backend))
+            if not e:
+                return None
+            valid = [t for t in e.get("trials", ())
+                     if t.get("framework_version") == fw
+                     and t.get("jax_version") == jx
+                     and isinstance(t.get("per_step_s"), (int, float))]
+            if not valid:
+                return None
+            return min(valid, key=lambda t: t["per_step_s"])
+
+    def trial_count(self) -> int:
+        with self._lock:
+            return sum(len(e.get("trials", ()))
+                       for e in self._load().values())
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {"schema": _SCHEMA, "path": self.path,
+                    "entries": json.loads(json.dumps(self._load()))}
+
+
+_db_cache: Dict[str, CostDatabase] = {}
+_db_lock = threading.Lock()
+
+
+def get_database(path: Optional[str] = None) -> CostDatabase:
+    p = path or default_db_path()
+    with _db_lock:
+        db = _db_cache.get(p)
+        if db is None:
+            db = _db_cache[p] = CostDatabase(p)
+        return db
+
+
+def reset_database_cache() -> None:
+    """Test hook: drop memoized databases (a test pointing FLAGS_autotune_db
+    at a fresh tmp file must not see another test's entries)."""
+    with _db_lock:
+        _db_cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# executor compile-path feedback (the 'use' side)
+# ---------------------------------------------------------------------------
+
+_warned_lookup = False
+
+
+def lookup_best(program, batch_rows: int) -> Optional[TunedConfig]:
+    """Best-known config for (program, batch bucket, backend), or None.
+    Called from the executor compile path — NEVER raises; counts
+    ``autotune_hits_total`` / ``autotune_misses_total``."""
+    global _warned_lookup
+    try:
+        if autotune_mode() == "off":
+            return None
+        import jax
+
+        from .. import monitor
+
+        fp = program_content_fingerprint(program)
+        bucket = shape_bucket(batch_rows)
+        backend = jax.default_backend()
+        t = get_database().best(fp, bucket, backend)
+        hit = t is not None
+        if monitor.enabled():
+            monitor.counter(
+                "autotune_hits_total" if hit else "autotune_misses_total",
+                "autotuner compile-path lookups that found (hits) / did "
+                "not find (misses) a best-known config").inc()
+        if not hit:
+            return None
+        if monitor.enabled():
+            monitor.gauge(
+                "autotune_best_per_step_seconds",
+                "best-known measured step time fed to the compile path, "
+                "by program fingerprint and shape bucket").labels(
+                program=fp, bucket=str(bucket)).set(t["per_step_s"])
+        return TunedConfig.from_dict(t["candidate"])
+    except Exception as e:
+        if not _warned_lookup:
+            _warned_lookup = True
+            logger.warning("autotune lookup disabled after error: %s: %s",
+                           type(e).__name__, e)
+        return None
+
+
+def record_trial(program, batch_rows: int, config: TunedConfig,
+                 per_step_s: float, achieved_tflops: Optional[float] = None,
+                 db: Optional[CostDatabase] = None,
+                 save: bool = True) -> dict:
+    """Record one measured candidate (requires FLAGS_autotune=measure).
+    ``save=False`` defers the durable write — callers recording a batch
+    of trials (measure_candidates) save once at the end instead of
+    paying a lock+merge+fsync cycle per candidate."""
+    if autotune_mode() != "measure":
+        raise RuntimeError(
+            "recording autotune trials requires FLAGS_autotune=measure "
+            f"(currently {autotune_mode()!r})")
+    import jax
+
+    from .. import monitor
+
+    fp = program_content_fingerprint(program)
+    bucket = shape_bucket(batch_rows)
+    if monitor.enabled():
+        monitor.counter("autotune_trials_total",
+                        "measured autotuner candidates recorded into the "
+                        "cost database").inc()
+    return (db or get_database()).record(
+        fp, bucket, jax.default_backend(), config, per_step_s,
+        achieved_tflops, save=save)
+
+
+# ---------------------------------------------------------------------------
+# the measure loop (the 'measure' side; tools/xla_sweep.py + fusion_check
+# drive this)
+# ---------------------------------------------------------------------------
+
+def chained_step_seconds(exe, program, feed, fetch_list, scope,
+                         k_short: int = 2, k_long: int = 6,
+                         repeats: int = 1) -> float:
+    """Per-step seconds via the chained differencing protocol
+    (docs/PERF_NOTES.md): (T(k_long) - T(k_short)) / (k_long - k_short),
+    each T the min over ``repeats`` timed dispatches after one untimed
+    warm-up (compile) dispatch, the final element read forcing the host
+    sync. The ONE shared implementation — bench.py, tools/xla_sweep.py,
+    tools/fusion_check.py and measure_candidates all time through here,
+    so their numbers stay comparable (the r05 infer discontinuity in
+    docs/PERF_NOTES.md is what silently-diverging copies of a measurement
+    protocol cost). Floored at 1e-9: a noise-negative difference is
+    meaningless, not a time machine."""
+    import numpy as np
+
+    def run_k(k: int) -> float:
+        def once() -> float:
+            t0 = time.perf_counter()
+            out = exe.run_chained(program, feed=feed,
+                                  fetch_list=fetch_list, steps=k,
+                                  scope=scope, return_numpy=False)
+            _ = float(np.asarray(out[0]).reshape(-1)[-1])
+            return time.perf_counter() - t0
+        once()
+        return min(once() for _ in range(repeats))
+
+    t_short, t_long = run_k(k_short), run_k(k_long)
+    return max((t_long - t_short) / (k_long - k_short), 1e-9)
+
+
+def default_candidates(include_gemm_blocks: bool = False
+                       ) -> List[TunedConfig]:
+    import jax
+
+    sets = (TPU_OPTION_SETS if jax.default_backend() == "tpu"
+            else CPU_OPTION_SETS)
+    cands = [TunedConfig.make(o) for o in sets]
+    if include_gemm_blocks:
+        for blocks in GEMM_BLOCK_SETS:
+            if blocks is not None:
+                cands.append(TunedConfig.make({}, blocks))
+    return cands
+
+
+def measure_candidates(exe, program, feed, fetch_list, scope,
+                       candidates: Optional[Sequence[TunedConfig]] = None,
+                       k_short: int = 2, k_long: int = 6, repeats: int = 1,
+                       batch_rows: Optional[int] = None,
+                       db: Optional[CostDatabase] = None) -> dict:
+    """Measure ``candidates`` on ``program`` with the honest
+    chained-differencing protocol (docs/PERF_NOTES.md) and record every
+    successful trial into the cost database. Returns the ranked report
+    (the tool artifact). Requires ``FLAGS_autotune=measure``.
+
+    NOT safe under concurrent traffic: each candidate is applied through
+    process-global set_flags, so any other thread compiling mid-sweep
+    (e.g. a serving dispatch sharing this executor) compiles under the
+    candidate's transient flags. The trial guard is process-global so
+    such a thread at least never mixes DB-filled knobs on top — but run
+    sweeps offline, not under a live serving engine."""
+    from .. import set_flags
+    from ..flags import get_flags
+
+    if autotune_mode() != "measure":
+        raise RuntimeError("measure_candidates requires FLAGS_autotune="
+                           f"measure (currently {autotune_mode()!r})")
+    if batch_rows is None:
+        from ..executor import _feed_batch_rows
+
+        batch_rows = _feed_batch_rows(feed)
+    candidates = list(candidates if candidates is not None
+                      else default_candidates())
+
+    prev = get_flags(["FLAGS_xla_options", "FLAGS_fused_gemm_blocks"])
+    trials = []
+    database = db or get_database()
+    recorded = 0
+    try:
+        with trial_guard():
+            for cand in candidates:
+                set_flags({
+                    "FLAGS_xla_options": json.dumps(cand.options_dict()),
+                    "FLAGS_fused_gemm_blocks": ",".join(
+                        str(b) for b in cand.gemm_blocks)
+                    if cand.gemm_blocks else "",
+                })
+                label = json.dumps(cand.to_dict(), sort_keys=True)
+                try:
+                    per_step = chained_step_seconds(
+                        exe, program, feed, fetch_list, scope,
+                        k_short=k_short, k_long=k_long, repeats=repeats)
+                    rec = record_trial(program, batch_rows, cand, per_step,
+                                       db=database, save=False)
+                    recorded += 1
+                    trials.append({"candidate": cand.to_dict(),
+                                   "status": "ok",
+                                   "per_step_s": per_step,
+                                   "recorded_at": rec["recorded_at"]})
+                except Exception as e:
+                    trials.append({"candidate": cand.to_dict(),
+                                   "status": "error",
+                                   "error": f"{type(e).__name__}: {e}"[:300]})
+                    logger.warning("autotune candidate %s failed: %s",
+                                   label, e)
+    finally:
+        set_flags(prev)
+        # one durable write for the whole batch (in the finally so an
+        # interrupted sweep keeps the trials measured before the crash)
+        if recorded:
+            try:
+                database.save()
+            except Exception as e:
+                logger.warning("autotune DB save failed: %s: %s",
+                               type(e).__name__, e)
+
+    ok = sorted((t for t in trials if t["status"] == "ok"),
+                key=lambda t: t["per_step_s"])
+    for rank, t in enumerate(ok):
+        t["rank"] = rank
+    return {
+        "program": program_content_fingerprint(program),
+        "bucket": shape_bucket(batch_rows),
+        "trials": trials,
+        "best": ok[0] if ok else None,
+    }

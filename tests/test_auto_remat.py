@@ -363,3 +363,56 @@ def test_no_dtype_truncation_warnings():
             exe.run(startup)
             (out,) = exe.run(main, feed=feed, fetch_list=[loss.name])
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_no_truncation_warning_on_argmax_astype_path():
+    """The astype flavour of the BENCH-tail spam (ISSUE 13 satellite):
+    argmax/top_k cast their indices to int64 via ``Array.astype`` — with a
+    failed-open x64 probe that emitted one UserWarning per traced op."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            am = fluid.layers.arg_max(x, axis=1)
+            fc64 = fluid.layers.fill_constant([4], "int64", 3)
+            s = (fluid.layers.cast(am, "float32")
+                 + fluid.layers.reduce_mean(
+                     fluid.layers.cast(fc64, "float32")))
+            outv = fluid.layers.mean(s)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            (out,) = exe.run(main, feed={"x": np.zeros((4, 8), np.float32)},
+                             fetch_list=[outv.name])
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_jnp_dtype_survives_broken_introspection(monkeypatch):
+    """The axon-bench failure mode (ISSUE 13 satellite): on that backend's
+    jax build ``jax.dtypes.canonicalize_dtype`` raised AND
+    ``jax.config.jax_enable_x64`` was an always-truthy holder object, so
+    jnp_dtype failed OPEN to int64 and every traced fill/astype warned.
+    The behavioural probe must decide correctly even with both
+    introspection paths broken."""
+    import jax
+
+    from paddle_tpu.core import types as t
+
+    monkeypatch.setattr(t, "_X64_ACTIVE", None)
+
+    def boom(*a, **k):
+        raise TypeError("simulated: no canonicalize_dtype on this build")
+
+    monkeypatch.setattr(jax.dtypes, "canonicalize_dtype", boom)
+    try:
+        assert t.jnp_dtype("int64") == np.dtype("int32")
+        assert t.jnp_dtype("float64") == np.dtype("float32")
+        assert t.jnp_dtype("uint64") == np.dtype("uint32")
+        # narrow + float dtypes pass through untouched
+        assert t.jnp_dtype("int32") == np.dtype("int32")
+        assert t.jnp_dtype("bfloat16").name == "bfloat16"
+    finally:
+        t._X64_ACTIVE = None  # drop the probe memo poisoned by this test

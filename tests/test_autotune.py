@@ -1,0 +1,336 @@
+"""paddle_tpu.tuning — the persistent autotuner: cost-database round trip,
+content fingerprints, mode gating (off|use|measure), executor compile-path
+feedback (best-known config in the cache key, hit/miss counters), and
+staleness invalidation. The cross-process round trip (a fresh 'use'
+process compiling straight to the measured best with zero re-trials) is
+proven end-to-end by tools/fusion_check.py in CI."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.unique_name as un
+from paddle_tpu import monitor, tuning
+
+
+@pytest.fixture(autouse=True)
+def _tuning_isolation(tmp_path):
+    prev = fluid.get_flags(["FLAGS_autotune", "FLAGS_autotune_db",
+                            "FLAGS_xla_options",
+                            "FLAGS_fused_gemm_blocks"])
+    fluid.set_flags({"FLAGS_autotune_db":
+                     str(tmp_path / "autotune_db.json")})
+    tuning.reset_database_cache()
+    yield
+    fluid.set_flags(prev)
+    tuning.reset_database_cache()
+
+
+def _program(width=64, seed=7):
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[width], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(x, width, act="relu")
+            pred = fluid.layers.fc(h, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    main.random_seed = seed
+    return main, startup, loss
+
+
+# ---------------------------------------------------------------------------
+# database
+# ---------------------------------------------------------------------------
+
+def test_db_round_trip_and_best():
+    db = tuning.get_database()
+    c1 = tuning.TunedConfig.make({"xla_cpu_enable_fast_min_max": True})
+    c2 = tuning.TunedConfig.make({}, (256, 128, 128))
+    db.record("fp1", 64, "cpu", c1, 0.02)
+    db.record("fp1", 64, "cpu", c2, 0.01)
+    best = db.best("fp1", 64, "cpu")
+    assert best["candidate"]["gemm_blocks"] == [256, 128, 128]
+    # re-measuring a candidate replaces its trial, never duplicates
+    db.record("fp1", 64, "cpu", c2, 0.03)
+    assert db.trial_count() == 2
+    assert db.best("fp1", 64, "cpu")["candidate"]["xla_options"] == {
+        "xla_cpu_enable_fast_min_max": True}
+    # durable: a fresh CostDatabase object reloads from disk
+    db2 = tuning.CostDatabase(db.path)
+    assert db2.trial_count() == 2
+    assert db2.best("fp1", 64, "cpu") == db.best("fp1", 64, "cpu")
+
+
+def test_db_version_staleness_invalidates():
+    """Trials recorded by a different framework/jax version are invisible
+    to best() — a compiler upgrade invalidates its measurements."""
+    db = tuning.get_database()
+    db.record("fp2", 32, "cpu", tuning.TunedConfig.make({}), 0.01)
+    with db._lock:
+        for e in db._load().values():
+            for t in e["trials"]:
+                t["jax_version"] = "0.0.0-other"
+    assert db.best("fp2", 32, "cpu") is None
+
+
+def test_db_corrupt_file_degrades_to_empty(tmp_path):
+    p = str(tmp_path / "bad.json")
+    with open(p, "w") as f:
+        f.write("{not json")
+    db = tuning.CostDatabase(p)
+    assert db.trial_count() == 0
+    db.record("fp", 1, "cpu", tuning.TunedConfig.make({}), 0.5)
+    assert tuning.CostDatabase(p).trial_count() == 1
+
+
+def test_shape_bucket_powers_of_two():
+    assert [tuning.shape_bucket(b) for b in (1, 2, 3, 64, 65, 128)] == \
+        [1, 2, 4, 64, 128, 128]
+
+
+def test_content_fingerprint_stable_across_builds():
+    m1, _, _ = _program()
+    m2, _, _ = _program()
+    m3, _, _ = _program(width=32)
+    assert tuning.program_content_fingerprint(m1) == \
+        tuning.program_content_fingerprint(m2)
+    assert tuning.program_content_fingerprint(m1) != \
+        tuning.program_content_fingerprint(m3)
+    assert m1._serial != m2._serial  # serials differ; content hash doesn't
+
+
+# ---------------------------------------------------------------------------
+# mode gating
+# ---------------------------------------------------------------------------
+
+def test_record_requires_measure_mode():
+    main, _, _ = _program()
+    for mode in ("off", "use"):
+        fluid.set_flags({"FLAGS_autotune": mode})
+        with pytest.raises(RuntimeError, match="measure"):
+            tuning.record_trial(main, 8, tuning.TunedConfig.make({}), 0.1)
+    fluid.set_flags({"FLAGS_autotune": "measure"})
+    tuning.record_trial(main, 8, tuning.TunedConfig.make({}), 0.1)
+    assert tuning.get_database().trial_count() == 1
+
+
+def test_lookup_off_mode_never_touches_db():
+    main, _, _ = _program()
+    fluid.set_flags({"FLAGS_autotune": "off"})
+    assert tuning.lookup_best(main, 8) is None
+    assert not os.path.exists(tuning.default_db_path())
+
+
+# ---------------------------------------------------------------------------
+# executor integration
+# ---------------------------------------------------------------------------
+
+def _seed_best(main, batch, opts):
+    fluid.set_flags({"FLAGS_autotune": "measure"})
+    tuning.record_trial(main, batch,
+                        tuning.TunedConfig.make(opts), 0.001)
+    # a worse candidate the executor must NOT pick
+    tuning.record_trial(main, batch, tuning.TunedConfig.make({}), 0.5)
+
+
+def test_executor_use_mode_compiles_best_config():
+    main, startup, loss = _program()
+    batch = 16
+    best_opts = {"xla_cpu_enable_fast_min_max": True}
+    _seed_best(main, batch, best_opts)
+    fluid.set_flags({"FLAGS_autotune": "use"})
+    monitor.reset()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(batch, 64).astype(np.float32),
+            "y": rng.randn(batch, 1).astype(np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+    assert np.isfinite(lv).all()
+    assert (monitor.metric_value("autotune_hits_total") or 0) >= 1
+    assert (monitor.metric_value("autotune_trials_total") or 0) == 0
+    ev = [e for e in monitor.recompile_events(recompiles_only=False)
+          if e.components.get("xla_options")]
+    assert ev, "no compile carried the tuned options"
+    assert dict(ev[-1].components["xla_options"]) == best_opts
+
+
+def test_explicit_flags_beat_db():
+    main, startup, loss = _program()
+    batch = 16
+    _seed_best(main, batch, {"xla_cpu_enable_fast_min_max": True})
+    fluid.set_flags({"FLAGS_autotune": "use",
+                     "FLAGS_xla_options":
+                     json.dumps({"xla_llvm_disable_expensive_passes":
+                                 True})})
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(batch, 64).astype(np.float32),
+            "y": rng.randn(batch, 1).astype(np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+    ev = [e for e in monitor.recompile_events(recompiles_only=False)
+          if e.components.get("xla_options")]
+    assert dict(ev[-1].components["xla_options"]) == {
+        "xla_llvm_disable_expensive_passes": True}
+
+
+def test_measure_candidates_records_and_ranks():
+    main, startup, loss = _program()
+    fluid.set_flags({"FLAGS_autotune": "measure"})
+    monitor.reset()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 64).astype(np.float32),
+            "y": rng.randn(8, 1).astype(np.float32)}
+    cands = [tuning.TunedConfig.make({}),
+             tuning.TunedConfig.make({"xla_cpu_enable_fast_min_max": True})]
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rep = tuning.measure_candidates(exe, main, feed, [loss.name],
+                                        scope, candidates=cands,
+                                        k_short=2, k_long=4)
+    ok = [t for t in rep["trials"] if t["status"] == "ok"]
+    assert len(ok) == 2 and rep["best"] is not None
+    assert tuning.get_database().trial_count() == 2
+    assert (monitor.metric_value("autotune_trials_total") or 0) == 2
+    # and a subsequent use-mode executor reuses the best with no trials
+    fluid.set_flags({"FLAGS_autotune": "use"})
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe2.run(main, feed=feed, fetch_list=[loss.name])
+    assert tuning.get_database().trial_count() == 2
+    assert (monitor.metric_value("autotune_hits_total") or 0) >= 1
+
+
+def test_autotune_off_drops_tuned_blocks_and_no_program_stamp():
+    """Turning FLAGS_autotune off drops the DB's influence entirely: the
+    off-mode compile must carry gemm_blocks=None in its compile components
+    (a distinct cache key — it recompiles, it does not reuse the tuned
+    executable). The tuned blocks are threaded per-compile, never stamped
+    on the shared Program: a stamp read lazily at jit-trace time could be
+    overwritten by a concurrent compile with a different tuned config."""
+    main, startup, loss = _program()
+    batch = 16
+    fluid.set_flags({"FLAGS_autotune": "measure"})
+    tuning.record_trial(main, batch,
+                        tuning.TunedConfig.make({}, (256, 128, 128)),
+                        0.001)
+    fluid.set_flags({"FLAGS_autotune": "use"})
+    monitor.reset()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(batch, 64).astype(np.float32),
+            "y": rng.randn(batch, 1).astype(np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        evs = [e for e in monitor.recompile_events(recompiles_only=False)
+               if "gemm_blocks" in e.components]
+        assert evs[-1].components["gemm_blocks"] == (256, 128, 128)
+        fluid.set_flags({"FLAGS_autotune": "off"})
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        evs = [e for e in monitor.recompile_events(recompiles_only=False)
+               if "gemm_blocks" in e.components]
+        assert evs[-1].components["gemm_blocks"] is None
+    assert not hasattr(main, "_tuned_gemm_blocks")
+
+
+def test_use_mode_hits_db_with_epilogue_fusion_enabled():
+    """Record/lookup key consistency under fusion: trials are recorded
+    under the SUBMITTED program's content fingerprint, and the executor
+    must look up with that same fingerprint even though it compiles the
+    fused clone (whose content — fused_gemm_epilogue ops — differs)."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[64], dtype="float32")
+            h = fluid.layers.fc(x, 64, act="relu")
+            pred = fluid.layers.fc(h, 64)
+    batch = 16
+    best_opts = {"xla_cpu_enable_fast_min_max": True}
+    fluid.set_flags({"FLAGS_autotune": "measure"})
+    tuning.record_trial(main, batch, tuning.TunedConfig.make(best_opts),
+                        0.001)
+    prev = fluid.get_flags(["FLAGS_epilogue_fusion"])
+    fluid.set_flags({"FLAGS_autotune": "use", "FLAGS_epilogue_fusion": 1})
+    monitor.reset()
+    try:
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        feed = {"x": np.random.RandomState(0).randn(
+            batch, 64).astype(np.float32)}
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(main, feed=feed, fetch_list=[pred.name])
+        # the executor really swapped in a fused clone...
+        assert any(op.type == "fused_gemm_epilogue"
+                   for p in exe._fusion_cache.values()
+                   for op in p.global_block.ops)
+        # ...and the DB lookup still hit the submitted program's entry
+        assert (monitor.metric_value("autotune_hits_total") or 0) >= 1
+        evs = [e for e in monitor.recompile_events(recompiles_only=False)
+               if e.components.get("xla_options")]
+        assert evs and dict(evs[-1].components["xla_options"]) == best_opts
+    finally:
+        fluid.set_flags(prev)
+
+
+def test_measure_trial_not_contaminated_by_db_best():
+    """The in-trial guard: while measure_candidates runs a candidate, the
+    executor must compile exactly that candidate's config — never fill its
+    unset knobs from the DB's best-known entry, or the baseline {} trial
+    would be silently measured under the tuned config and recorded as if
+    the default achieved its step time."""
+    main, startup, loss = _program()
+    batch = 8
+    fluid.set_flags({"FLAGS_autotune": "measure"})
+    tuning.record_trial(main, batch,
+                        tuning.TunedConfig.make(
+                            {"xla_cpu_enable_fast_min_max": True}),
+                        0.000001)   # an irresistibly fast best
+    monitor.reset()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(batch, 64).astype(np.float32),
+            "y": rng.randn(batch, 1).astype(np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rep = tuning.measure_candidates(
+            exe, main, feed, [loss.name], scope,
+            candidates=[tuning.TunedConfig.make({})], k_short=2, k_long=4)
+    assert [t["status"] for t in rep["trials"]] == ["ok"]
+    # every compile issued during the trial carried the candidate's empty
+    # options, not the DB best
+    for e in monitor.recompile_events(recompiles_only=False):
+        assert not dict(e.components.get("xla_options") or ()), \
+            "trial compile leaked the DB's best-known xla_options"
+
+
+def test_concurrent_recorders_merge_on_save(tmp_path):
+    """Two DB instances sharing one file (two measure-mode processes)
+    must union their trials on save, not last-writer-wins."""
+    p = str(tmp_path / "shared_db.json")
+    a, b = tuning.CostDatabase(p), tuning.CostDatabase(p)
+    a._load()
+    b._load()          # both memoize the (empty) file before either saves
+    a.record("fp", 16, "cpu", tuning.TunedConfig.make({"opt_a": True}),
+             0.5)
+    b.record("fp", 16, "cpu", tuning.TunedConfig.make({"opt_b": True}),
+             0.4)
+    fresh = tuning.CostDatabase(p)
+    e = fresh._load()[tuning.CostDatabase.key("fp", 16, "cpu")]
+    cands = [t["candidate"]["xla_options"] for t in e["trials"]]
+    assert {"opt_a": True} in cands and {"opt_b": True} in cands

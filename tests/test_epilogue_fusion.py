@@ -1,0 +1,601 @@
+"""FLAGS_epilogue_fusion — the GEMM-epilogue fusion pass
+(analysis/epilogue_fusion.py + ops/fused_gemm.py + kernels/fused_gemm.py).
+
+Covers the ISSUE-13 fusion-correctness checklist: pattern-match positive
+and negative controls (fetched intermediate refuses, multi-consumer
+refuses, backward-carrying program refuses), the fused-vs-unfused
+numerical witness per epilogue kind, compile-cache separation (the fused
+program gets its own ``_serial``), and kernel-vs-reference parity across
+tile-boundary shapes (interpret mode — no hardware needed)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.unique_name as un
+from paddle_tpu.analysis.epilogue_fusion import (FusionDecision,
+                                                 fuse_epilogues)
+from paddle_tpu.kernels.fused_gemm import (classify_gemm, fused_gemm,
+                                           fused_gemm_reference)
+
+
+@pytest.fixture(autouse=True)
+def _flag_reset():
+    prev = fluid.get_flags(["FLAGS_epilogue_fusion", "FLAGS_use_fused_gemm",
+                            "FLAGS_fused_gemm_blocks"])
+    yield
+    fluid.set_flags(prev)
+
+
+def _mlp(act="gelu", width=128, fetch_mid=False):
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[width], dtype="float32")
+            h = fluid.layers.fc(x, width, act=act)
+            pred = fluid.layers.fc(h, width)
+    return main, startup, pred
+
+
+def _run(main, startup, fetch, feed, fused):
+    fluid.set_flags({"FLAGS_epilogue_fusion": fused})
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (out,) = exe.run(main, feed=feed, fetch_list=[fetch])
+    return np.asarray(out), exe
+
+
+def _feed(width=128, batch=32, seed=0):
+    return {"x": np.random.RandomState(seed).randn(
+        batch, width).astype(np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# pattern matching: positive and negative controls
+# ---------------------------------------------------------------------------
+
+def test_fuses_bias_activation_chain_and_matches_bitwise():
+    main, startup, pred = _mlp("gelu")
+    feed = _feed()
+    base, _ = _run(main, startup, pred.name, feed, fused=False)
+    fused, exe = _run(main, startup, pred.name, feed, fused=True)
+    assert np.array_equal(base, fused)
+    fp = next(p for p in exe._fusion_cache.values()
+              if any(op.type == "fused_gemm_epilogue"
+                     for op in p.global_block.ops))
+    types = [op.type for op in fp.global_block.ops]
+    assert types.count("fused_gemm_epilogue") == 2
+    assert "mul" not in types and "elementwise_add" not in types
+
+
+def test_applied_chains_report_pt750_and_unsupported_tiling_pt755():
+    """PT750 per fused chain; PT755 when the chain's GEMM dims have no
+    kernel tiling (n=100 is not lane-aligned — the dense replay runs)."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[128], dtype="float32")
+            good = fluid.layers.fc(x, 128, act="relu")
+            bad = fluid.layers.fc(good, 100)     # n=100: no kernel tiling
+    diags = []
+    dec = fuse_epilogues(main, fetch_names=[bad.name], diags=diags)
+    assert dec.applied and dec.n_fused == 2
+    codes = [d.code for d in diags]
+    assert codes.count("PT750") == 2
+    assert codes.count("PT755") == 1
+    pt755 = next(d for d in diags if d.code == "PT755")
+    assert "n=100" in pt755.message
+
+
+def test_chain_kinds_matched():
+    """Every epilogue kind the kernel supports pattern-matches and carries
+    its parts label."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[128], dtype="float32")
+            h = fluid.layers.fc(x, 128)                    # mul+bias
+            r = fluid.layers.elementwise_add(h, x)         # +residual
+            ln = fluid.layers.layer_norm(r, begin_norm_axis=1)
+            out = fluid.layers.fc(ln, 128, act="relu")     # mul+bias+relu
+    dec = fuse_epilogues(main, fetch_names=[out.name])
+    assert dec.applied
+    kinds = sorted(c["epilogue"] for c in dec.chains)
+    assert kinds == ["bias+relu", "bias+residual+layer_norm"]
+
+
+def test_fetched_intermediate_refuses():
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[128], dtype="float32")
+            h = fluid.layers.fc(x, 128, act="gelu")
+    # fetch the TRUE mid-chain intermediate (the mul output): the chain
+    # must not extend past a fetched value, leaving nothing to fuse
+    mul_out = next(op.output("Out")[0] for op in main.global_block.ops
+                   if op.type == "mul")
+    diags = []
+    dec = fuse_epilogues(main, fetch_names=[h.name, mul_out], diags=diags)
+    assert not dec.applied
+    assert any(d.code == "PT751" for d in diags)
+    # and the executor still runs the untransformed program correctly
+    feed = _feed()
+    out, exe = _run(main, startup, h.name, feed, fused=True)
+    base, _ = _run(main, startup, h.name, feed, fused=False)
+    assert np.array_equal(out, base)
+
+
+def test_multi_consumer_intermediate_refuses():
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[128], dtype="float32")
+            h = fluid.layers.fc(x, 128)          # mul + bias
+            a = fluid.layers.gelu(h)
+            b = fluid.layers.relu(h)             # second consumer of h
+            out = fluid.layers.elementwise_add(a, b)
+    diags = []
+    dec = fuse_epilogues(main, fetch_names=[out.name], diags=diags)
+    # the mul+bias prefix may fuse (the mul output feeds only the add),
+    # but the bias output must NOT fold its activation in
+    assert any(d.code == "PT752" for d in diags)
+    if dec.applied:
+        assert all("gelu" not in c["epilogue"] and "relu" not in
+                   c["epilogue"] for c in dec.chains)
+
+
+def test_backward_program_refuses():
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[64], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            p = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    diags = []
+    dec = fuse_epilogues(main, fetch_names=[loss.name], diags=diags)
+    assert not dec.applied and "backward" in dec.reason
+    assert any(d.code == "PT753" for d in diags)
+
+
+def test_layer_norm_with_consumed_stats_refuses_ln_fold():
+    """A layer_norm whose Mean output is fetched cannot fold away."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[128], dtype="float32")
+            h = fluid.layers.fc(x, 128)
+            ln = fluid.layers.layer_norm(h, begin_norm_axis=1)
+    ln_op = next(op for op in main.global_block.ops
+                 if op.type == "layer_norm")
+    mean_name = ln_op.output("Mean")[0]
+    dec = fuse_epilogues(main, fetch_names=[ln.name, mean_name])
+    # the bias part may still fuse; layer_norm must survive unfused
+    if dec.applied:
+        assert all("layer_norm" not in c["epilogue"] for c in dec.chains)
+
+
+# ---------------------------------------------------------------------------
+# numerical witness per epilogue kind
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["bias", "bias+relu", "bias+gelu",
+                                  "bias+residual",
+                                  "bias+residual+layer_norm"])
+def test_fused_matches_unfused_per_epilogue_kind(kind):
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[128], dtype="float32")
+            act = ("relu" if "relu" in kind
+                   else "gelu" if "gelu" in kind else None)
+            h = fluid.layers.fc(x, 128, act=act)
+            if "residual" in kind:
+                h = fluid.layers.elementwise_add(h, x)
+            if "layer_norm" in kind:
+                h = fluid.layers.layer_norm(h, begin_norm_axis=1)
+    dec = fuse_epilogues(main, fetch_names=[h.name])
+    assert dec.applied and dec.n_fused == 1
+    assert dec.chains[0]["epilogue"] == kind
+    feed = _feed()
+    base, _ = _run(main, startup, h.name, feed, fused=False)
+    fused, _ = _run(main, startup, h.name, feed, fused=True)
+    # dense route (CPU suite): the fused op replays the original rules —
+    # exact bits, the fidelity contract the witness enforces
+    assert np.array_equal(base, fused)
+
+
+def test_witness_refuses_wrong_lowering(monkeypatch):
+    """Break the fused op's lowering: the fidelity witness must catch it
+    and the pass must refuse rather than emit a wrong program."""
+    from paddle_tpu.core import registry
+
+    opdef = registry.get_op_def("fused_gemm_epilogue")
+    real = opdef.lower
+
+    def wrong(ctx, ins, attrs):
+        out = real(ctx, ins, attrs)
+        out["Out"] = [v + 1.0 for v in out["Out"]]
+        return out
+
+    monkeypatch.setattr(opdef, "lower", wrong)
+    main, startup, pred = _mlp("gelu")
+    diags = []
+    dec = fuse_epilogues(main, fetch_names=[pred.name], diags=diags)
+    assert not dec.applied and "witness" in dec.reason
+    assert any(d.code == "PT754" for d in diags)
+
+
+def test_amp_program_fuses_and_matches():
+    """Under the AMP policy the fused op must reproduce the unfused
+    chain's per-op casts (mul white-listed, epilogue params untouched)."""
+    from paddle_tpu.contrib import mixed_precision as mp
+
+    main, startup, pred = _mlp("gelu")
+    mp.decorate_program(main)
+    feed = _feed()
+    base, _ = _run(main, startup, pred.name, feed, fused=False)
+    fused, _ = _run(main, startup, pred.name, feed, fused=True)
+    assert np.array_equal(base, fused)
+
+
+# ---------------------------------------------------------------------------
+# cache separation + executor integration
+# ---------------------------------------------------------------------------
+
+def test_fused_program_gets_own_serial_and_cache_entries():
+    main, startup, pred = _mlp("gelu")
+    feed = _feed()
+    fluid.set_flags({"FLAGS_epilogue_fusion": 1})
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (a,) = exe.run(main, feed=feed, fetch_list=[pred.name])
+        fluid.set_flags({"FLAGS_epilogue_fusion": 0})
+        (b,) = exe.run(main, feed=feed, fetch_list=[pred.name])
+    fp = next(iter(exe._fusion_cache.values()))
+    assert fp._serial != main._serial
+    serials = {k[0][0] for k in exe._cache}
+    # both the fused clone and the plain program compiled their own steps
+    assert fp._serial in serials and main._serial in serials
+    assert np.array_equal(a, b)
+
+
+def test_run_chained_fused_matches_plain():
+    main, startup, pred = _mlp("relu")
+    feed = _feed()
+
+    def chained(fused):
+        fluid.set_flags({"FLAGS_epilogue_fusion": fused})
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            outs = exe.run_chained(main, feed=feed,
+                                   fetch_list=[pred.name], steps=3,
+                                   scope=scope)
+        return np.asarray(outs[0])
+
+    assert np.array_equal(chained(False), chained(True))
+
+
+# ---------------------------------------------------------------------------
+# kernel-vs-reference parity across tile-boundary shapes (interpret mode)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shape", [(128, 128, 128), (64, 128, 256),
+                                   (256, 384, 128), (8, 128, 128)])
+@pytest.mark.parametrize("kind", ["plain", "bias+gelu", "ln"])
+def test_kernel_parity_tile_boundaries(shape, kind):
+    import jax.numpy as jnp
+
+    m, n, k = shape
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(m, k).astype(np.float32))
+    y = jnp.asarray(rng.randn(k, n).astype(np.float32))
+    kw = {}
+    if kind != "plain":
+        kw["bias"] = jnp.asarray(rng.randn(n).astype(np.float32))
+    if kind == "bias+gelu":
+        kw["activation"] = "gelu"
+    if kind == "ln":
+        kw["layer_norm"] = True
+        kw["ln_scale"] = jnp.asarray(rng.randn(n).astype(np.float32))
+        kw["ln_bias"] = jnp.asarray(rng.randn(n).astype(np.float32))
+    got = np.asarray(fused_gemm(x, y, interpret=True, **kw))
+    want = np.asarray(fused_gemm_reference(x, y, **kw))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-4)
+
+
+def test_classify_refuses_bad_tilings_with_reason():
+    kind, reason = classify_gemm(100, 128, 128)
+    assert kind == "unsupported" and "block_m=100" in reason
+    kind, reason = classify_gemm(128, 1000, 128)
+    assert kind == "unsupported" and "n=1000" in reason
+    kind, reason = classify_gemm(128, 128, 100)
+    assert kind == "unsupported" and "k=100" in reason
+    # layer_norm demands the whole row in one block
+    kind, reason = classify_gemm(128, 4096 * 4, 128, layer_norm=True)
+    assert kind == "unsupported" and "layer_norm" in reason
+    assert classify_gemm(128, 256, 128)[0] == "supported"
+
+
+def test_always_mode_raises_loudly_on_unsupported_tiling():
+    from paddle_tpu.ops.fused_gemm import fused_gemm_route
+
+    fluid.set_flags({"FLAGS_use_fused_gemm": "always"})
+    with pytest.raises(ValueError, match="no kernel tiling"):
+        fused_gemm_route(100, 128, 128, layer_norm=False,
+                         blocks=(128, 128, 128))
+
+
+def test_kernel_route_matches_dense_route():
+    """FLAGS_use_fused_gemm=always runs the interpret-mode kernel off-TPU;
+    results must sit within the declared witness tolerance of the dense
+    replay (the same bound the fusion witness enforces)."""
+    main, startup, pred = _mlp("gelu")
+    feed = _feed()
+    base, _ = _run(main, startup, pred.name, feed, fused=False)
+    fluid.set_flags({"FLAGS_use_fused_gemm": "always"})
+    fused, _ = _run(main, startup, pred.name, feed, fused=True)
+    np.testing.assert_allclose(base, fused, rtol=2e-4, atol=1e-4)
+
+
+def test_tuned_blocks_flag_changes_cache_key():
+    """Flipping FLAGS_fused_gemm_blocks must recompile, never silently
+    reuse the old executable (blocks are part of the compile-cache key)."""
+    from paddle_tpu import monitor
+
+    main, startup, pred = _mlp("relu")
+    feed = _feed()
+    fluid.set_flags({"FLAGS_epilogue_fusion": 1})
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[pred.name])
+        n0 = len(exe._cache)
+        fluid.set_flags({"FLAGS_fused_gemm_blocks": "64,128,128"})
+        (out,) = exe.run(main, feed=feed, fetch_list=[pred.name])
+        assert len(exe._cache) == n0 + 1
+    assert np.isfinite(out).all()
+
+
+def test_fully_fused_program_reports_no_phantom_refusals():
+    """The probe past a chain's surviving output is not a refusal: a
+    fully-fused MLP whose final output is fetched must report
+    n_refused == 0 and no PT751/PT752 for the value the fused op itself
+    writes."""
+    main, startup, pred = _mlp()
+    diags = []
+    dec = fuse_epilogues(main, feed_names=["x"],
+                         fetch_names=[pred.name], diags=diags)
+    assert dec.applied and dec.n_fused == 2
+    assert dec.n_refused == 0
+    phantom = [d for d in diags if d.code in ("PT751", "PT752")]
+    assert not phantom, phantom
+
+
+# ---------------------------------------------------------------------------
+# write hazards between chain ops (PT756) — never a wrong program
+# ---------------------------------------------------------------------------
+
+def _clobbered_input_program():
+    """mul -> increment(x, in_place) -> elementwise_add -> relu: the
+    increment rewrites the chain's X input between the mul (its original
+    read) and the chain's last op (where the fused op would read it)."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[128], dtype="float32")
+            h = fluid.layers.fc(x, 128, act="relu")
+            fluid.layers.increment(x, in_place=True)
+    gb = main.global_block
+    gb.ops.insert(1, gb.ops.pop())      # [mul, increment, add, relu]
+    main._bump_version()
+    return main, startup, h
+
+
+def test_inplace_rewrite_of_chain_input_refuses_pt756():
+    main, startup, h = _clobbered_input_program()
+    assert [op.type for op in main.global_block.ops] == [
+        "mul", "increment", "elementwise_add", "relu"]
+    diags = []
+    dec = fuse_epilogues(main, fetch_names=[h.name], diags=diags)
+    assert not dec.applied
+    assert any(d.code == "PT756" for d in diags), diags
+
+
+def test_inplace_rewrite_runs_untransformed_and_matches():
+    """Executor path: with fusion ON the clobbered program must run
+    bit-identically to fusion OFF — it refuses, never a wrong program
+    (before the PT756 gate this fused and returned (x+1)@W values)."""
+    main, startup, h = _clobbered_input_program()
+    feed = _feed()
+    base, _ = _run(main, startup, h.name, feed, fused=False)
+    fused, exe = _run(main, startup, h.name, feed, fused=True)
+    assert np.array_equal(base, fused)
+    assert not any(op.type == "fused_gemm_epilogue"
+                   for p in exe._fusion_cache.values()
+                   for op in p.global_block.ops)
+
+
+def test_clobbered_intermediate_refuses_pt756():
+    """A non-chain op that WRITES (without reading) a chain intermediate
+    between its def and its read: the original add consumes the clobbered
+    value, the fused op would recompute from the mul — refuse."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[128], dtype="float32")
+            h = fluid.layers.fc(x, 128)
+    gb = main.global_block
+    mul_idx, mul_out = next(
+        (i, op.output("Out")[0]) for i, op in enumerate(gb.ops)
+        if op.type == "mul")
+    gb.append_op("fill_constant", outputs={"Out": [mul_out]},
+                 attrs={"shape": [32, 128], "dtype": "float32",
+                        "value": 0.0})
+    gb.ops.insert(mul_idx + 1, gb.ops.pop())    # [mul, fill, add]
+    main._bump_version()
+    diags = []
+    dec = fuse_epilogues(main, fetch_names=[h.name], diags=diags)
+    assert not dec.applied
+    assert any(d.code == "PT756" for d in diags), diags
+
+
+def test_residual_produced_between_chain_ops_still_fuses():
+    """The legitimate def-between-chain-ops case: a residual operand
+    PRODUCED (first write) between the matmul and its add is not a
+    hazard — the fused op sits at the chain's last position precisely so
+    this read stays def-before-use."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[128], dtype="float32")
+            h = fluid.layers.fc(x, 128)                # mul, add (bias)
+            r = fluid.layers.relu(x)                   # residual producer
+            o = fluid.layers.elementwise_add(h, r)     # + residual
+    gb = main.global_block
+    types = [op.type for op in gb.ops]
+    assert types == ["mul", "elementwise_add", "relu", "elementwise_add"]
+    diags = []
+    dec = fuse_epilogues(main, fetch_names=[o.name], diags=diags)
+    assert dec.applied, [str(d) for d in diags]
+    assert not any(d.code == "PT756" for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# alpha-scaled matmul: one route authority (op, witness, PT755 agree)
+# ---------------------------------------------------------------------------
+
+def _alpha_chain(alpha):
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            a = fluid.layers.data("a", shape=[128, 128], dtype="float32",
+                                  append_batch_size=False)
+            b = fluid.layers.data("b", shape=[128, 128], dtype="float32",
+                                  append_batch_size=False)
+            c = fluid.layers.data("c", shape=[128], dtype="float32",
+                                  append_batch_size=False)
+            mm = fluid.layers.matmul(a, b, alpha=alpha)
+            o = fluid.layers.relu(fluid.layers.elementwise_add(mm, c))
+    rng = np.random.RandomState(0)
+    feed = {"a": rng.randn(128, 128).astype(np.float32),
+            "b": rng.randn(128, 128).astype(np.float32),
+            "c": rng.randn(128).astype(np.float32)}
+    return main, startup, o, feed
+
+
+def test_alpha_scaled_matmul_routes_dense_and_reports_pt755():
+    """alpha != 1 has no kernel variant: the shared route authority
+    (fused_gemm_route) sends the witness down the bit-exact dense path
+    and PT755 records why — even though the 128^3 tiling itself is
+    kernel-supported."""
+    from paddle_tpu.ops.fused_gemm import fused_gemm_route
+
+    main, startup, o, feed = _alpha_chain(2.0)
+    diags = []
+    dec = fuse_epilogues(main, fetch_names=[o.name], diags=diags)
+    assert dec.applied and dec.n_fused == 1
+    pt755 = [d for d in diags if d.code == "PT755"]
+    assert len(pt755) == 1 and "alpha=2.0" in pt755[0].message, pt755
+    # the op lowering and the witness agree: primitive, even under the
+    # 'always' promise (there is no kernel variant to insist on)
+    route, reason = fused_gemm_route(128, 128, 128, layer_norm=False,
+                                     blocks=(128, 128, 128), alpha=2.0)
+    assert route == "primitive" and "alpha" in reason
+    fluid.set_flags({"FLAGS_use_fused_gemm": "always"})
+    route, _ = fused_gemm_route(128, 128, 128, layer_norm=False,
+                                blocks=(128, 128, 128), alpha=2.0)
+    assert route == "primitive"
+
+
+def test_alpha_scaled_matmul_fused_is_bit_exact():
+    main, startup, o, feed = _alpha_chain(2.0)
+    base, _ = _run(main, startup, o.name, feed, fused=False)
+    fused, exe = _run(main, startup, o.name, feed, fused=True)
+    assert np.array_equal(base, fused)
+    assert any(op.type == "fused_gemm_epilogue"
+               for p in exe._fusion_cache.values()
+               for op in p.global_block.ops)
+
+
+# ---------------------------------------------------------------------------
+# the witness runs the configuration that actually runs
+# ---------------------------------------------------------------------------
+
+def test_amp_program_fuses_on_kernel_route():
+    """Under AMP the kernel route must hand back the unfused chain's
+    promoted dtype (bf16 GEMM output meeting f32 epilogue params -> f32):
+    before the out_dtype fix the witness meta check refused every AMP
+    program on exactly the kernel route, so fusion never applied in its
+    showcase configuration."""
+    from paddle_tpu.contrib import mixed_precision as mp
+
+    main, startup, pred = _mlp("gelu")
+    mp.decorate_program(main)
+    fluid.set_flags({"FLAGS_use_fused_gemm": "always"})
+    dec = fuse_epilogues(main, fetch_names=[pred.name])
+    assert dec.applied and dec.n_fused == 2, dec.reason
+    feed = _feed()
+    base, _ = _run(main, startup, pred.name, feed, fused=False)
+    fused, _ = _run(main, startup, pred.name, feed, fused=True)
+    assert base.dtype == fused.dtype == np.float32
+    tol = 2e-2      # WITNESS_TOLERANCES['bfloat16']: the compute dtype
+    assert np.allclose(base, fused, rtol=tol, atol=tol)
+
+
+def test_witness_batch_resolves_dynamic_dims():
+    """The executor plumbs the real feed rows into the pass; the PT755
+    tiling report must classify at that m, not the sentinel 8 — a
+    batch-250 feed is not sublane-aligned even though the sentinel is."""
+    main, startup, pred = _mlp("relu")
+    diags = []
+    dec = fuse_epilogues(main, fetch_names=[pred.name], diags=diags,
+                         batch=250)
+    assert dec.applied
+    pt755 = [d for d in diags if d.code == "PT755"]
+    assert pt755 and "m=250" in pt755[0].message, pt755
+    # the sentinel default (8) IS aligned: no PT755
+    diags8 = []
+    dec8 = fuse_epilogues(main, fetch_names=[pred.name], diags=diags8)
+    assert dec8.applied
+    assert not [d for d in diags8 if d.code == "PT755"]
+
+
+def test_witness_runs_the_tuned_gemm_blocks():
+    """gemm_blocks (the autotuner config the executor threads into the
+    real compile's LowerCtx) must reach the witness and the PT755
+    classify: a block size that does not divide the problem flips the
+    route to dense, and the report must say so."""
+    main, startup, pred = _mlp("relu")
+    diags = []
+    dec = fuse_epilogues(main, fetch_names=[pred.name], diags=diags,
+                         gemm_blocks=(128, 128, 100))
+    assert dec.applied
+    pt755 = [d for d in diags if d.code == "PT755"]
+    assert pt755 and "block_k=100" in pt755[0].message, pt755
+
+
+def test_executor_fusion_cache_keys_on_tuned_blocks():
+    """A cost-DB update that changes the tuned gemm blocks must
+    re-witness: the executor's fusion-decision cache key includes the
+    blocks resolved for this compile."""
+    main, startup, pred = _mlp("relu")
+    feed = _feed()
+    fluid.set_flags({"FLAGS_epilogue_fusion": 1})
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[pred.name])
+        n0 = len(exe._fusion_cache)
+        fluid.set_flags({"FLAGS_fused_gemm_blocks": "64,128,128"})
+        exe.run(main, feed=feed, fetch_list=[pred.name])
+    assert len(exe._fusion_cache) == n0 + 1
+    assert any(k[2] == (64, 128, 128) for k in exe._fusion_cache)

@@ -62,8 +62,8 @@ def test_book_model_programs_verify_clean():
 def test_every_code_is_documented_and_tested():
     # the CODES table is the single source of truth; this file (or
     # test_pass_manager.py, which owns the PT70x-PT72x pass-manager
-    # families, or test_sharding_check.py, which owns PT73x) must cover
-    # every code
+    # families, test_sharding_check.py, which owns PT73x, or
+    # test_epilogue_fusion.py, which owns PT75x) must cover every code
     import io
     import os
 
@@ -73,7 +73,9 @@ def test_every_code_is_documented_and_tested():
                   os.path.join(os.path.dirname(here),
                                "test_pass_manager.py"),
                   os.path.join(os.path.dirname(here),
-                               "test_sharding_check.py")):
+                               "test_sharding_check.py"),
+                  os.path.join(os.path.dirname(here),
+                               "test_epilogue_fusion.py")):
         with io.open(fname, "r", encoding="utf-8") as f:
             me += f.read()
     assert len(CODES) >= 10

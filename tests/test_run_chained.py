@@ -128,3 +128,71 @@ def test_scope_serial_keys_cache_not_id():
     gc.collect()
     c = fluid.Scope()
     assert c._serial not in seen  # serials never recycle, unlike id()
+
+
+def test_chained_serializes_inference_with_identity_carry():
+    """The r03->r05 ResNet-50 infer bench discontinuity (ISSUE 13
+    satellite): a for_test clone's only carried state is identity-written
+    batch_norm statistics (use_global_stats writes MeanOut = Mean), so the
+    old `not carried` trigger skipped the anti-hoisting chain, XLA's
+    while-loop simplifier saw the fixed-point carry, hoisted the body, and
+    the chained per-step time differenced to ~zero. Non-training programs
+    must now ALWAYS engage the chain — and stay numerically identical to
+    single runs (the perturbation is runtime-zero)."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        loss = _build(with_bn=True)
+        main, startup = (fluid.default_main_program(),
+                         fluid.default_startup_program())
+        infer = main.clone(for_test=True)
+        feed = _feed()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            one = exe.run(infer, feed=feed, fetch_list=[loss.name])[0]
+            stacked = exe.run_chained(infer, feed=feed,
+                                      fetch_list=[loss.name], steps=3)[0]
+            # training program for contrast: carried params chain it
+            exe.run_chained(main, feed=feed, fetch_list=[loss.name],
+                            steps=2, scope=scope)
+    steps = {}
+    for key, step in exe._cache.items():
+        if key[0] == "chained":
+            steps[key[1][0]] = step
+    infer_step = steps[infer._serial]
+    train_step = steps[main._serial]
+    # the infer program carries BN stats (identity) yet must chain; the
+    # training program chains through its genuinely-updated params
+    assert infer_step.carried_names, "bn stats should be carried state"
+    assert infer_step.needs_chain is True
+    assert train_step.needs_chain is False
+    for i in range(3):
+        np.testing.assert_allclose(np.asarray(stacked)[i],
+                                   np.asarray(one), rtol=1e-6)
+
+
+def test_chained_feedless_state_program_no_hoist_warning():
+    """A feed-less program whose per-step variation lives in persistable
+    carried state (the GPT decode shape: KV caches / token carry) must
+    not warn about hoisting — the body reads the carry it rewrites, so
+    XLA cannot hoist it, and the warning would fire on every serving
+    decode dispatch."""
+    import warnings
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        v = fluid.layers.create_global_var(shape=[1], value=0.0,
+                                           dtype="float32",
+                                           persistable=True)
+        fluid.layers.increment(v, value=1.0, in_place=True)
+        main, startup = (fluid.default_main_program(),
+                         fluid.default_startup_program())
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            with warnings.catch_warnings():
+                warnings.simplefilter("error", RuntimeWarning)
+                stacked = exe.run_chained(main, fetch_list=[v.name],
+                                          steps=3)[0]
+    # genuinely serialized: each step sees the previous step's counter
+    np.testing.assert_allclose(np.asarray(stacked).reshape(-1),
+                               [1.0, 2.0, 3.0])

@@ -26,7 +26,15 @@ Usage:
 Option sets that XLA rejects (unknown flag for the backend) are recorded as
 failed trials, not fatal: the artifact shows exactly which sets are legal
 on this backend. Methodology notes: docs/PERF_NOTES.md "XLA option
-sweeps"."""
+sweeps".
+
+This tool is now the CLI of the PERSISTENT tuning loop
+(``paddle_tpu.tuning`` — docs/PERF_NOTES.md "Persistent autotuner"): with
+``FLAGS_autotune=measure`` every successful trial is also recorded into
+the durable cost database (keyed by program content fingerprint, shape
+bucket, backend), so the next process with ``FLAGS_autotune=use`` compiles
+straight to the best-known options with zero re-trials. Without the flag
+the behaviour is the original one-shot sweep."""
 from __future__ import annotations
 
 import argparse
@@ -39,27 +47,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-# TPU-oriented candidate sets: scheduling/fusion knobs that historically
-# move dense-training throughput. Swept, never assumed — the artifact says
-# what actually helped on the attached backend.
-TPU_OPTION_SETS = [
-    {},
-    {"xla_tpu_enable_latency_hiding_scheduler": True},
-    {"xla_enable_async_all_gather": True,
-     "xla_enable_async_collective_permute": True},
-    {"xla_tpu_enable_latency_hiding_scheduler": True,
-     "xla_enable_async_all_gather": True},
-]
-
-# CPU-legal sets so the sweep (and its CI gate) exercises the full
-# plumbing on the forced-CPU suite.
-CPU_OPTION_SETS = [
-    {},
-    {"xla_cpu_enable_fast_min_max": True},
-    {"xla_llvm_disable_expensive_passes": True},
-    {"xla_cpu_enable_fast_min_max": True,
-     "xla_llvm_disable_expensive_passes": True},
-]
+# candidate sets live in paddle_tpu.tuning now (the persistent loop and
+# this CLI sweep the same space); re-exported here for script compat
+from paddle_tpu.tuning import CPU_OPTION_SETS, TPU_OPTION_SETS  # noqa: E402
 
 
 def _probe_mlp(width=256, depth=4, batch=64):
@@ -130,26 +120,18 @@ PROBES = {"mlp": lambda ci: _probe_mlp(),
 
 
 def time_one(main, startup, loss_name, feed, k_short, k_long, repeats):
-    """Per-step seconds via the chained differencing protocol (bench.py)."""
+    """Per-step seconds in a fresh executor/scope, timed through the one
+    shared chained-differencing implementation (tuning.chained_step_seconds)."""
     import paddle_tpu as fluid
+    from paddle_tpu import tuning
 
     exe = fluid.Executor(fluid.TPUPlace())
     scope = fluid.Scope()
-
-    def run_k(k):
-        def once():
-            t0 = time.perf_counter()
-            out = exe.run_chained(main, feed=feed, fetch_list=[loss_name],
-                                  steps=k, scope=scope, return_numpy=False)
-            _ = float(np.asarray(out[0]).reshape(-1)[-1])
-            return time.perf_counter() - t0
-        once()  # compile + warm
-        return min(once() for _ in range(repeats))
-
     with fluid.scope_guard(scope):
         exe.run(startup)
-        t_short, t_long = run_k(k_short), run_k(k_long)
-    return max((t_long - t_short) / (k_long - k_short), 1e-9)
+        return tuning.chained_step_seconds(
+            exe, main, feed, [loss_name], scope,
+            k_short=k_short, k_long=k_long, repeats=repeats)
 
 
 def sweep(models, option_sets, ci: bool, k_short, k_long, repeats) -> dict:
@@ -157,12 +139,20 @@ def sweep(models, option_sets, ci: bool, k_short, k_long, repeats) -> dict:
 
     import paddle_tpu as fluid
 
+    from paddle_tpu import tuning
+
+    persist = tuning.autotune_mode() == "measure"
     report = {"backend": jax.default_backend(),
               "protocol": "run_chained differencing: "
                           f"(T({k_long})-T({k_short}))/{k_long - k_short}, "
                           f"min over {repeats} repeats",
+              "autotune_db": tuning.default_db_path() if persist else None,
               "models": {}}
     prev = fluid.get_flags(["FLAGS_xla_options"])
+    # one shared DB handle, one durable write per model (record_trial
+    # save=False memoizes in the handle; per-trial saves would pay a
+    # flock + merge + fsync + atomic-rewrite cycle for every candidate)
+    database = tuning.get_database() if persist else None
     try:
         for mname in models:
             main, startup, loss_name, feed = PROBES[mname](ci)
@@ -172,11 +162,31 @@ def sweep(models, option_sets, ci: bool, k_short, k_long, repeats) -> dict:
                 label = json.dumps(opts, sort_keys=True)
                 t0 = time.time()
                 try:
-                    per_step = time_one(main, startup, loss_name, feed,
-                                        k_short, k_long, repeats)
+                    # trial_guard: the executor must compile exactly these
+                    # options — in measure mode it would otherwise fill
+                    # unset knobs (gemm blocks, and the {} baseline's
+                    # options) from the DB's best-known entry
+                    with tuning.trial_guard():
+                        per_step = time_one(main, startup, loss_name, feed,
+                                            k_short, k_long, repeats)
                     trials.append({"options": opts, "status": "ok",
                                    "per_step_s": per_step,
                                    "sweep_s": round(time.time() - t0, 2)})
+                    if persist:
+                        # the durable loop: this measurement feeds the next
+                        # process's compile path (FLAGS_autotune=use). A
+                        # failed DB write degrades to a warning — the
+                        # timing above succeeded, so the artifact keeps
+                        # exactly one 'ok' row for this candidate
+                        batch = max([1] + [np.asarray(v).shape[0]
+                                           for v in feed.values()])
+                        try:
+                            tuning.record_trial(
+                                main, batch, tuning.TunedConfig.make(opts),
+                                per_step, db=database, save=False)
+                        except Exception as e:
+                            print(f"[{mname}] {label}: DB record failed "
+                                  f"({type(e).__name__}: {e})", flush=True)
                     print(f"[{mname}] {label}: "
                           f"{per_step * 1e3:.3f} ms/step", flush=True)
                 except Exception as e:
@@ -198,6 +208,14 @@ def sweep(models, option_sets, ci: bool, k_short, k_long, repeats) -> dict:
                 "best_options": ok[0]["options"] if ok else None,
                 "best_per_step_s": ok[0]["per_step_s"] if ok else None,
             }
+            if database is not None:
+                # one durable write per model: a crash mid-sweep keeps
+                # every completed model's trials
+                try:
+                    database.save()
+                except Exception as e:
+                    print(f"[{mname}] DB save failed "
+                          f"({type(e).__name__}: {e})", flush=True)
     finally:
         fluid.set_flags(prev)
     return report
