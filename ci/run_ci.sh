@@ -121,6 +121,31 @@ if ! grep -q -- "-> FAIL" "$SERVING_NEG_LOG"; then
   exit 1
 fi
 
+echo "== fleet serving gate (paddle_tpu.serving.fleet: two replica PROCESSES"
+echo "   behind the load-aware router, one SIGTERMed mid-burst — the fleet"
+echo "   sheds nothing it admitted, every request reaches exactly one outcome"
+echo "   fleet-wide, p50/p99 end-to-end latency recorded; a cold replica"
+echo "   restarted with the warm-start AOT executable cache must report"
+echo "   measurably faster time-to-ready than its cold baseline)"
+JAX_PLATFORMS=cpu python tools/load_check.py --ci --fleet \
+  --log-dir "${CI_ARTIFACT_DIR:-.}" \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_fleet_report.json" | tail -8
+echo "== fleet negative control (router drain honoring + unadmitted retry"
+echo "   disabled: the kill scenario must FAIL the gate)"
+FLEET_NEG_LOG="${CI_ARTIFACT_DIR:-.}/ci_fleet_negative.log"
+if JAX_PLATFORMS=cpu python tools/load_check.py --ci --fleet \
+     --negative-control --log-dir "${CI_ARTIFACT_DIR:-.}" \
+     > "$FLEET_NEG_LOG" 2>&1; then
+  echo "load_check --fleet did NOT fail with router drain disabled" >&2
+  exit 1
+fi
+# non-zero exit must be the gate tripping, not the harness crashing
+if ! grep -q -- "-> FAIL" "$FLEET_NEG_LOG"; then
+  echo "fleet negative control exited non-zero WITHOUT tripping the gate:" >&2
+  tail -20 "$FLEET_NEG_LOG" >&2
+  exit 1
+fi
+
 echo "== trace gate (paddle_tpu.trace: every request in exactly one complete"
 echo "   trace, flight-recorder dumps on injected batch fault + watchdog hang,"
 echo "   cost-model FLOPs within 10% of analytic, near-zero off overhead;"

@@ -42,6 +42,7 @@ from . import resilience
 from . import serving
 from . import analysis
 from . import tuning
+from . import aot_cache
 from .core import registry as op_registry
 from .flags import get_flags, set_flags
 from .layers import learning_rate_scheduler  # registers fluid.layers.* decays

@@ -240,6 +240,11 @@ class _CompiledStep:
         self._aot = None
         # pending monitor CompileRecord awaiting stage timings
         self._compile_event = None
+        # durable-identity material for the warm-start executable cache
+        # (FLAGS_aot_cache_dir): (kind, program, fetch, xla_opts,
+        # gemm_blocks, extras...) stamped by the cache owner; combined
+        # with the arg signature at first call (paddle_tpu.aot_cache)
+        self._aot_cache_parts: Optional[tuple] = None
         # serializes the one-time AOT build when two threads race the same
         # step (serving dispatcher vs a user thread)
         self._aot_lock = threading.Lock()
@@ -1060,6 +1065,9 @@ class Executor:
                                  tuple(fetch_names))
             step.program = program
             step.needs_chain = needs_chain
+            step._aot_cache_parts = ("chained", program,
+                                     tuple(fetch_names), xla_opts,
+                                     gemm_blocks, int(steps))
             step._compile_event = _monitor.observe_compile(
                 "chained", program,
                 components={
@@ -1294,6 +1302,16 @@ class Executor:
                                      scope, xla_opts=opts,
                                      gemm_blocks=gemm_blocks)
             step.program = program
+            if not flag("check_nan_inf"):
+                # nan-checked steps are NOT disk-cached: their per-op
+                # provenance labels (nan_check_meta) are filled at trace
+                # time, which a loaded executable skips — a tripped
+                # check would lose the op attribution that is the
+                # flag's whole point. (The chained path's coarse
+                # host-side check carries no meta, so it stays cached.)
+                step._aot_cache_parts = ("run", program,
+                                         tuple(fetch_names), xla_opts,
+                                         gemm_blocks)
             step._compile_event = _monitor.observe_compile(
                 "run", program,
                 components={
@@ -1353,6 +1371,29 @@ class Executor:
             ev, step._compile_event = step._compile_event, None
             t_trace = t_compile = None
 
+            # warm-start probe (FLAGS_aot_cache_dir): a serialized
+            # executable for this exact (program content, arg signature,
+            # compiler config, backend/version) identity loads instead of
+            # compiling — the fleet tier's cold-replica path. Loads never
+            # raise; a miss falls through to the normal build, which then
+            # publishes its executable for the next process.
+            from . import aot_cache as _aot_cache
+
+            cache_dir = _aot_cache.cache_dir_flag()
+            cache_key = None
+            if cache_dir and step._aot_cache_parts is not None:
+                cache_key = _aot_cache.executable_key(
+                    step._aot_cache_parts, args)
+                t0 = time.perf_counter()
+                loaded = _aot_cache.load_executable(cache_dir, cache_key)
+                if loaded is not None:
+                    step._aot = loaded
+                    # the monitor's compile record stays paired: the
+                    # "xla compile" stage is the deserialize+load time
+                    _monitor.complete_compile(ev, 0.0,
+                                              time.perf_counter() - t0)
+                    return step._aot
+
             def _build():
                 # transient-site: compiles hit flaky infra (preempted
                 # backend, cache-server hiccups) — retried with backoff.
@@ -1375,6 +1416,11 @@ class Executor:
                         program=int(getattr(step.program, "_serial", -1))):
                     step._aot, t_trace, t_compile = \
                         call_with_retry("compile", _build)
+                if cache_key is not None and step._aot:
+                    # publish for the next cold process (atomic; failures
+                    # warn once and never break the step)
+                    _aot_cache.save_executable(cache_dir, cache_key,
+                                               step._aot)
             except RetryExhaustedError as e:
                 if isinstance(e.last_error, _faults.InjectedFault):
                     # a scripted fault outlasting the retry budget must

@@ -257,6 +257,17 @@ _DEFS: Dict[str, tuple] = {
                  "executor compile path automatically; explicit flags "
                  "still win), measure (use + the measure loop may run "
                  "trials and record them). docs/PERF_NOTES.md"),
+    "aot_cache_dir": (str, "",
+                      "warm-start AOT executable cache directory "
+                      "(paddle_tpu.aot_cache): after every successful "
+                      "XLA compile the executable is serialized here, "
+                      "and later processes load instead of compiling — "
+                      "a cold serving replica joins the fleet warm. "
+                      "Keyed by program CONTENT fingerprint + arg "
+                      "signature + compiler config + backend/versions; "
+                      "corrupt or version-mismatched entries degrade to "
+                      "a recompile with one warning. Empty disables "
+                      "(default). docs/SERVING.md"),
     "autotune_db": (str, "",
                     "path of the autotuner cost database (JSON, atomic "
                     "rewrite); empty = ~/.cache/paddle_tpu/"

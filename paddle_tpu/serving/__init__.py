@@ -29,10 +29,12 @@ from __future__ import annotations
 
 from ..resilience.deadline import Deadline, DeadlineExceeded
 from .breaker import CircuitBreaker
-from .engine import (BatchFailed, CircuitOpen, EngineStopped, Overloaded,
+from .engine import (HEALTH_SCHEMA_KEYS, HEALTH_SCHEMA_VERSION,
+                     BatchFailed, CircuitOpen, EngineStopped, Overloaded,
                      ServingConfig, ServingEngine, ServingError,
                      ServingFuture)
 from .generate import GenerationConfig, GenerativeEngine
+from . import fleet
 
 __all__ = [
     "ServingEngine", "ServingConfig", "ServingFuture", "CircuitBreaker",
@@ -40,4 +42,8 @@ __all__ = [
     # typed terminal outcomes
     "ServingError", "Overloaded", "CircuitOpen", "BatchFailed",
     "EngineStopped", "DeadlineExceeded",
+    # the frozen health()/ready() wire contract (docs/SERVING.md)
+    "HEALTH_SCHEMA_VERSION", "HEALTH_SCHEMA_KEYS",
+    # the network tier (front-end, router, wire schema, replica worker)
+    "fleet",
 ]

@@ -69,11 +69,24 @@ from .breaker import CircuitBreaker
 
 __all__ = ["ServingConfig", "ServingEngine", "ServingFuture",
            "ServingError", "Overloaded", "CircuitOpen", "BatchFailed",
-           "EngineStopped", "DeadlineExceeded"]
+           "EngineStopped", "DeadlineExceeded",
+           "HEALTH_SCHEMA_VERSION", "HEALTH_SCHEMA_KEYS"]
 
 logger = logging.getLogger("paddle_tpu.serving")
 
 OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+# The health()/ready() payload is a WIRE CONTRACT since the fleet tier:
+# the router's load-aware dispatch reads these keys over HTTP, so the
+# schema is versioned and frozen (docs/SERVING.md "Health probe schema").
+# Adding a key is a minor change (bump nothing, document it); renaming or
+# removing one breaks deployed routers and requires a version bump plus a
+# compatibility note. tests/test_fleet.py regression-tests this set.
+HEALTH_SCHEMA_VERSION = 1
+HEALTH_SCHEMA_KEYS = frozenset({
+    "schema_version", "status", "ready", "queue_depth", "queue_limit",
+    "degraded", "current_max_batch", "open_buckets", "accounting",
+})
 
 
 # ---------------------------------------------------------------------------
@@ -465,14 +478,18 @@ class ServingEngine:
 
     # -- submission ------------------------------------------------------
     def submit(self, feed: Dict[str, Any], *, priority: int = 0,
-               deadline_s: Optional[float] = None) -> ServingFuture:
+               deadline_s: Optional[float] = None,
+               trace_parent=None) -> ServingFuture:
         """Admit one request (any thread). ``feed`` maps every declared
         feed name to an array with a leading batch dim (usually 1).
         Raises a typed :class:`ServingError` subclass when rejected —
-        that raise IS the request's terminal outcome."""
+        that raise IS the request's terminal outcome. ``trace_parent``
+        (a ``trace.Span``/``SpanContext``, e.g. reconstructed from the
+        fleet wire headers) parents the request's root span so one trace
+        id follows the request across processes."""
         # validation first: a malformed feed (ValueError) is a caller bug,
         # not a submitted request — it never enters the accounting
-        req = self._build_request(feed, priority, deadline_s)
+        req = self._build_request(feed, priority, deadline_s, trace_parent)
         # admission runs as a child span of the request root, so a typed
         # rejection still ships a complete (if short) trace
         sub = _trace.start_span("serving.submit", parent=req.span,
@@ -518,7 +535,8 @@ class ServingEngine:
             self._work.notify()
         return req.future
 
-    def _build_request(self, feed, priority, deadline_s) -> _Request:
+    def _build_request(self, feed, priority, deadline_s,
+                       trace_parent=None) -> _Request:
         vals = {}
         nrows = None
         for n in self._feed_names:
@@ -553,11 +571,21 @@ class ServingEngine:
                        submitted=time.monotonic(), future=ServingFuture())
         # one trace per request, minted at submit: the root span stays
         # open across the queue + the dispatch thread and is settled with
-        # the typed terminal outcome (exactly once, like the accounting)
-        req.span = _trace.root_span("serving.request", seq=seq,
-                                    rows=nrows, priority=int(priority))
+        # the typed terminal outcome (exactly once, like the accounting).
+        # A trace_parent carried over the fleet wire keeps the CALLER's
+        # trace id instead of minting a fresh one, so one id is
+        # debuggable router -> frontend -> engine -> flight recorder
+        req.span = self._request_root(trace_parent, seq=seq, rows=nrows,
+                                      priority=int(priority))
         req.future.trace_id = req.span.trace_id
         return req
+
+    @staticmethod
+    def _request_root(trace_parent, **attrs):
+        if trace_parent is not None:
+            return _trace.start_span("serving.request",
+                                     parent=trace_parent, **attrs)
+        return _trace.root_span("serving.request", **attrs)
 
     def _admit_locked(self, req: _Request, now: float) -> None:
         """Admission control under ``_lock``: raises typed Overloaded on
@@ -1060,8 +1088,11 @@ class ServingEngine:
         return acct
 
     def health(self) -> dict:
-        """Liveness/pressure snapshot (wire into any HTTP layer as the
-        health probe body)."""
+        """Liveness/pressure snapshot. This payload is the fleet tier's
+        WIRE CONTRACT (``/healthz`` serves it verbatim and the router's
+        load-aware dispatch reads it): the key set is versioned and
+        frozen as :data:`HEALTH_SCHEMA_KEYS` — see docs/SERVING.md
+        "Health probe schema" before changing anything here."""
         with self._lock:
             depth = len(self._queue)
             degraded = self._degraded
@@ -1072,7 +1103,8 @@ class ServingEngine:
                         if b.state != "closed"]
         status = ("stopped" if not running
                   else "degraded" if degraded or open_buckets else "ok")
-        return {"status": status, "ready": self.ready(),
+        return {"schema_version": HEALTH_SCHEMA_VERSION,
+                "status": status, "ready": self.ready(),
                 "queue_depth": depth,
                 "queue_limit": self.config.queue_depth,
                 "degraded": degraded, "current_max_batch": cur_max,

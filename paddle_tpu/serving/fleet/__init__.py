@@ -1,0 +1,40 @@
+"""paddle_tpu.serving.fleet — the network serving tier above the engine.
+
+PRs 8–11 built a production single-process engine (continuous batching,
+typed outcomes, exact accounting, streaming generation); this package is
+the layer the reference stack serves real traffic through
+(``paddle/fluid/operators/distributed/`` + the serving fleet,
+PAPER.md §L7) rebuilt on three parts:
+
+* :mod:`~paddle_tpu.serving.fleet.frontend` — a stdlib HTTP/JSON server
+  per replica: ``/v1/submit``, ``/v1/generate`` (chunked token
+  streaming), ``/healthz`` (the frozen engine health schema) and
+  ``/readyz``, speaking the versioned wire schema of
+  :mod:`~paddle_tpu.serving.fleet.wire` (typed outcome -> distinct HTTP
+  status, trace-ID headers, priority/SLO classes, deadlines).
+* :mod:`~paddle_tpu.serving.fleet.router` — load-aware dispatch over N
+  replicas from each replica's pressure snapshot, honoring per-replica
+  drain, retrying *unadmitted* requests exactly once on a sibling, and
+  extending the exactly-one-outcome invariant fleet-wide.
+* warm start — replicas run with ``FLAGS_aot_cache_dir``
+  (:mod:`paddle_tpu.aot_cache`): a cold replica loads serialized AOT
+  executables instead of paying the compile storm, measured
+  cold-vs-warm in ``ci_fleet_report.json``.
+
+``python -m paddle_tpu.serving.fleet.replica`` runs one replica process
+(model probe + warm-up + front-end + SIGTERM drain);
+``tools/load_check.py --fleet`` is the CI gate. docs/SERVING.md "Fleet
+tier" has the architecture, wire schema and routing policy.
+"""
+from __future__ import annotations
+
+from .frontend import FrontendConfig, ServingFrontend
+from .router import FleetRouter, Replica, RouterConfig
+from .wire import (SLO_CLASSES, TRACE_HEADER, WIRE_SCHEMA_VERSION,
+                   ReplicaLost, WireError)
+
+__all__ = [
+    "ServingFrontend", "FrontendConfig", "FleetRouter", "Replica",
+    "RouterConfig", "ReplicaLost", "WireError", "WIRE_SCHEMA_VERSION",
+    "TRACE_HEADER", "SLO_CLASSES",
+]

@@ -1,0 +1,627 @@
+"""Load-aware replica router: dispatch over N fleet front-ends.
+
+The fleet's brain (docs/SERVING.md "Fleet tier — routing policy"): a
+:class:`FleetRouter` owns a set of replica addresses (each a
+``ServingFrontend`` over its own engine process), keeps a **pressure
+snapshot** per replica by polling ``/healthz`` (queue depth, breaker
+state, degradation, readiness — the engine's frozen health schema), and
+routes each request to the least-loaded READY replica.
+
+The contract extends the engine's exactly-one-outcome invariant
+fleet-wide:
+
+* **drain honor** — a preempted replica (SIGTERM -> drain) flips
+  ``ready()`` false; the router stops routing to it while its admitted
+  requests finish. Nothing a replica admitted is ever shed by routing.
+* **unadmitted retry, exactly once** — a dispatch the replica provably
+  did NOT admit (connection refused before the request was sent, or a
+  429 shed / 410 stopped rejection whose error body does not claim
+  admission — :func:`~.wire.response_is_unadmitted`: the front-end's
+  explicit ``admitted`` flag is authoritative, so an ADMITTED request
+  that settled ``EngineStopped`` also travels as 410 but is never
+  redispatched) is retried on ONE sibling. Anything possibly admitted
+  is never retried: a connection that dies after the request went out
+  settles as typed :class:`~.wire.ReplicaLost` — retrying it could
+  give one request two outcomes.
+* **capability-aware generate** — ``generate()`` routes only to
+  replicas whose health advertises the generative capability (mixed
+  fleets: a request/response replica answers /v1/generate with a 400
+  caller bug, so the router never sends one there).
+* **no hangs** — zero ready replicas is a typed
+  :class:`~paddle_tpu.serving.Overloaded` (``reason="no_ready_replica"``)
+  at submit, never a wait.
+* **trace propagation** — every dispatch carries the router's span
+  context in ``X-PT-Trace``; the replica's request root joins it, so one
+  trace id follows the request router -> frontend -> engine -> flight
+  recorder and ``accounting()['recent_outcomes']`` on either side names
+  the same id.
+
+``accounting()`` is the fleet-wide ledger (the ``load_check --fleet``
+gate's ground truth); metrics land on ``router_*``
+(docs/OBSERVABILITY.md).
+"""
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import logging
+import socket
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import monitor as _monitor
+from ... import trace as _trace
+from ...resilience.deadline import DeadlineExceeded
+from ..engine import Overloaded, ServingError
+from . import wire
+from .wire import ReplicaLost
+
+__all__ = ["FleetRouter", "Replica", "RouterConfig"]
+
+logger = logging.getLogger("paddle_tpu.serving.fleet")
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    """Routing knobs. ``honor_drain``/``retry_unadmitted`` exist so the
+    CI gate's negative control can prove the gate detects a router
+    without them — production routers keep both on."""
+
+    poll_interval_s: float = 0.2
+    connect_timeout_s: float = 5.0
+    request_timeout_s: float = 120.0
+    honor_drain: bool = True
+    retry_unadmitted: bool = True
+    # pressure score weights: being degraded or holding open breakers
+    # outweighs a handful of queued requests
+    degraded_penalty: int = 16
+    open_bucket_penalty: int = 8
+
+
+class Replica:
+    """One replica address + its last pressure snapshot."""
+
+    def __init__(self, replica_id: str, host: str, port: int):
+        self.replica_id = replica_id
+        self.host = host
+        self.port = int(port)
+        self._lock = threading.Lock()
+        self._snap: Dict[str, Any] = {"ok": False, "ready": False,
+                                      "queue_depth": 0, "degraded": False,
+                                      "open_buckets": 0, "generative": False,
+                                      "status": "unknown", "polled_at": 0.0}
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._snap)
+
+    def _update(self, snap: Dict[str, Any]) -> None:
+        with self._lock:
+            self._snap = snap
+
+    def __repr__(self):
+        return f"Replica({self.replica_id}@{self.address})"
+
+
+_TERMINAL_KEYS = ("completed", "shed", "deadline_exceeded", "failed",
+                  "circuit_open", "stopped", "replica_lost", "other_error")
+
+
+class FleetRouter:
+    """See module docstring. ``replicas``: ``Replica`` objects or
+    ``(replica_id, host, port)`` tuples. ``start()`` begins background
+    polling; ``submit``/``generate`` are thread-safe and blocking (run
+    them from your own worker threads for concurrency, exactly like
+    ``ServingEngine.submit`` callers)."""
+
+    def __init__(self, replicas: Sequence,
+                 config: Optional[RouterConfig] = None):
+        self.replicas: List[Replica] = [
+            r if isinstance(r, Replica) else Replica(*r) for r in replicas]
+        if not self.replicas:
+            raise ValueError("fleet router needs at least one replica")
+        self.config = config or RouterConfig()
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._poll_thread: Optional[threading.Thread] = None
+        self._stop_ev = threading.Event()
+        self._acct: Dict[str, int] = {"submitted": 0, "retries": 0}
+        self._acct.update({k: 0 for k in _TERMINAL_KEYS})
+        self._pending = 0
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "FleetRouter":
+        if self._poll_thread is not None:
+            return self
+        self.poll_now()
+        self._stop_ev.clear()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="paddle_tpu-fleet-router-poll",
+            daemon=True)
+        self._poll_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+        t, self._poll_thread = self._poll_thread, None
+        if t is not None:
+            t.join(5.0)
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- polling ---------------------------------------------------------
+    def _poll_loop(self) -> None:
+        while not self._stop_ev.wait(self.config.poll_interval_s):
+            self.poll_now()
+
+    def poll_now(self) -> None:
+        """One synchronous poll of every replica's ``/healthz``."""
+        ready = 0
+        for r in self.replicas:
+            snap = self._poll_one(r)
+            r._update(snap)
+            ready += bool(snap["ok"] and snap["ready"])
+            if _monitor.enabled():
+                _monitor.counter(
+                    "router_polls_total",
+                    "replica health polls by result").labels(
+                    replica=r.replica_id,
+                    result="ok" if snap["ok"] else "error").inc()
+        if _monitor.enabled():
+            _monitor.gauge(
+                "router_replicas_ready",
+                "replicas currently ready for routing").set(ready)
+
+    def _poll_one(self, r: Replica) -> Dict[str, Any]:
+        try:
+            conn = http.client.HTTPConnection(
+                r.host, r.port, timeout=self.config.connect_timeout_s)
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                raw = resp.read()
+            finally:
+                conn.close()
+            # the /healthz body is the engine's FROZEN health schema —
+            # its schema_version field is HEALTH_SCHEMA_VERSION, NOT the
+            # request wire schema, so it must not go through
+            # wire.loads()'s version gate: the router reads documented
+            # keys and tolerates a replica speaking a newer health schema
+            try:
+                body = json.loads(raw.decode("utf-8"))
+            except Exception:
+                body = {}
+            if not isinstance(body, dict):
+                body = {}
+            return {"ok": resp.status == 200,
+                    "ready": bool(body.get("ready")),
+                    "queue_depth": int(body.get("queue_depth", 0)),
+                    "degraded": bool(body.get("degraded")),
+                    "open_buckets": len(body.get("open_buckets") or ()),
+                    "generative": bool(body.get("generative")),
+                    "status": str(body.get("status", "unknown")),
+                    "polled_at": time.monotonic()}
+        except Exception as e:
+            return {"ok": False, "ready": False, "queue_depth": 0,
+                    "degraded": False, "open_buckets": 0,
+                    "generative": False,
+                    "status": f"unreachable:{type(e).__name__}",
+                    "polled_at": time.monotonic()}
+
+    # -- routing policy --------------------------------------------------
+    def _score(self, snap: Dict[str, Any]) -> int:
+        return (int(snap["queue_depth"])
+                + self.config.degraded_penalty * bool(snap["degraded"])
+                + self.config.open_bucket_penalty
+                * int(snap["open_buckets"]))
+
+    def _pick(self, exclude: Sequence[Replica] = (),
+              require_generative: bool = False) -> Optional[Replica]:
+        """Least-loaded routable replica (drain-aware unless the negative
+        control disabled it), round-robin among score ties. With
+        ``require_generative`` only replicas whose health advertises the
+        generative capability are candidates."""
+        # ONE snapshot per replica: filters and score must read the same
+        # poll (a concurrent poll-thread update between reads could pass
+        # a replica no single poll considered routable)
+        cands = [(r, r.snapshot()) for r in self.replicas
+                 if r not in exclude]
+        if require_generative:
+            cands = [(r, s) for r, s in cands if s.get("generative")]
+        if self.config.honor_drain:
+            cands = [(r, s) for r, s in cands if s["ok"] and s["ready"]]
+        if not cands:
+            return None
+        with self._lock:
+            self._rr += 1
+            rot = self._rr
+        scored = sorted(
+            ((self._score(s), (i + rot) % len(cands), r)
+             for i, (r, s) in enumerate(cands)), key=lambda t: t[:2])
+        return scored[0][2]
+
+    # -- accounting ------------------------------------------------------
+    def _note_submitted(self) -> None:
+        with self._lock:
+            self._acct["submitted"] += 1
+            self._pending += 1
+
+    def _note_outcome(self, key: str, replica: str = "") -> None:
+        with self._lock:
+            self._acct[key] += 1
+            self._pending -= 1
+        if _monitor.enabled():
+            _monitor.counter(
+                "router_dispatch_total",
+                "fleet-wide request terminal outcomes by replica (the "
+                "replica that produced the outcome; 'none' when no "
+                "replica was reachable)").labels(
+                replica=replica or "none", outcome=key).inc()
+
+    def _note_retry(self, reason: str) -> None:
+        with self._lock:
+            self._acct["retries"] += 1
+        if _monitor.enabled():
+            _monitor.counter(
+                "router_retries_total",
+                "unadmitted dispatches retried on a sibling, by reason"
+            ).labels(reason=reason).inc()
+
+    def accounting(self) -> dict:
+        """The fleet-wide ledger: ``submitted`` equals the sum of all
+        terminal outcomes plus ``pending`` (requests currently inside a
+        ``submit``/``generate`` call). The ``load_check --fleet`` gate's
+        invariant. ``retries`` counts sibling redispatches — a retried
+        request still reaches exactly ONE outcome."""
+        with self._lock:
+            acct = dict(self._acct)
+            acct["pending"] = self._pending
+        terminal = sum(acct[k] for k in _TERMINAL_KEYS)
+        acct["accounted"] = terminal + acct["pending"]
+        acct["exact"] = acct["accounted"] == acct["submitted"]
+        return acct
+
+    @staticmethod
+    def _outcome_key(e: BaseException) -> str:
+        if isinstance(e, Overloaded):
+            return "shed"
+        if isinstance(e, DeadlineExceeded):
+            return "deadline_exceeded"
+        if isinstance(e, ReplicaLost):
+            return "replica_lost"
+        from ..engine import BatchFailed, CircuitOpen, EngineStopped
+
+        if isinstance(e, BatchFailed):
+            return "failed"
+        if isinstance(e, CircuitOpen):
+            return "circuit_open"
+        if isinstance(e, EngineStopped):
+            return "stopped"
+        return "other_error"
+
+    # -- submit ----------------------------------------------------------
+    def submit(self, feed: Dict[str, Any], *, priority: Optional[int] = None,
+               slo_class: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> List[np.ndarray]:
+        """Route one request/response inference call. Returns the fetch
+        rows, or raises the SAME typed outcome classes the in-process
+        engine raises (reconstructed from the wire), plus
+        :class:`ReplicaLost` for a replica that died holding an admitted
+        request. Blocking; thread-safe."""
+        body = {"schema_version": wire.WIRE_SCHEMA_VERSION,
+                "feed": wire.encode_feed(feed)}
+        if priority is not None:
+            body["priority"] = int(priority)
+        if slo_class is not None:
+            body["slo_class"] = slo_class
+        if deadline_s is not None:
+            body["deadline_s"] = float(deadline_s)
+        span = _trace.root_span("router.request", route="submit")
+        self._note_submitted()
+        t0 = time.monotonic()
+        try:
+            status, resp_body, replica = self._dispatch(
+                "/v1/submit", body, span)
+            if status == 200:
+                outs = wire.decode_outputs(resp_body)
+                span.set_attribute("outcome", "completed")
+                span.set_attribute("replica", replica)
+                span.end()
+                self._note_outcome("completed", replica)
+                if _monitor.enabled():
+                    _monitor.histogram(
+                        "router_request_seconds",
+                        "end-to-end fleet request latency through the "
+                        "router (completed requests; p50/p99 in the "
+                        "snapshot)").observe(time.monotonic() - t0)
+                return outs
+            err = wire.error_from_body(resp_body,
+                                       f"replica {replica} status {status}")
+            err.replica = replica   # outcome attribution in the ledger
+            raise err
+        except BaseException as e:
+            self._note_outcome(self._outcome_key(e),
+                               getattr(e, "replica", ""))
+            span.end(error=e)
+            raise
+
+    def _route_with_retry(self, attempt, *, generative: bool = False):
+        """The unadmitted-retry policy, shared by ``submit`` and
+        ``generate`` dispatch. ``attempt(replica)`` runs ONE dispatch
+        attempt and classifies it:
+
+        * ``("final", value)``          — terminal: ``value()`` is
+          returned (or raises the typed outcome it closes over).
+        * ``("reject", status, value)`` — the replica answered with a
+          rejection :func:`wire.response_is_unadmitted` classified
+          retryable (the front-end's explicit ``admitted`` flag is
+          authoritative over the status map, so an ADMITTED request
+          that settled ``EngineStopped`` — also a 410 — is never
+          redispatched). Retried once, else ``value()``.
+        * ``("unadmitted", exc)``       — provably never received
+          (connection refused before any bytes moved). Retried once,
+          else typed :class:`ReplicaLost`.
+        * ``("lost", exc)``             — sent, then the connection
+          died: possibly admitted, NEVER retried —
+          :class:`ReplicaLost`.
+        """
+        tried: List[Replica] = []
+        while True:
+            r = self._pick(exclude=tried, require_generative=generative)
+            if r is None:
+                if tried:
+                    # the retry also found nobody: surface the original
+                    # rejection class as a shed (still typed)
+                    raise Overloaded(
+                        "fleet: no sibling available for unadmitted "
+                        "retry", reason="no_ready_replica")
+                if generative and self._pick() is not None:
+                    raise Overloaded(
+                        "fleet: no generative replica (the ready "
+                        "replicas serve request/response only)",
+                        reason="no_generative_replica")
+                raise Overloaded(
+                    "fleet: no ready replica (all draining, dead or "
+                    "unreachable)", reason="no_ready_replica")
+            outcome = attempt(r)
+            kind = outcome[0]
+            if kind == "final":
+                return outcome[1]()
+            if kind == "reject":
+                _, status, value = outcome
+                if self.config.retry_unadmitted and not tried:
+                    tried.append(r)
+                    self._note_retry(f"status_{status}")
+                    continue
+                return value()
+            if kind == "unadmitted":
+                _, exc = outcome
+                if self.config.retry_unadmitted and not tried:
+                    tried.append(r)
+                    self._note_retry("connect_error")
+                    continue
+                raise ReplicaLost(
+                    f"fleet: replica {r.replica_id} unreachable and "
+                    f"retry exhausted: {exc}", replica=r.replica_id)
+            # kind == "lost": possibly admitted — never retried
+            _, exc = outcome
+            raise ReplicaLost(
+                f"fleet: replica {r.replica_id} connection died after "
+                f"the request was sent (request may have been admitted; "
+                f"not retried): {exc}", replica=r.replica_id)
+
+    def _dispatch(self, path: str, body: dict,
+                  span) -> Tuple[int, dict, str]:
+        """POST with the unadmitted-retry policy. Returns
+        ``(status, body, replica_id)``; raises typed on transport-level
+        outcomes (no replica / replica lost)."""
+        def attempt(r: Replica):
+            outcome = self._post_once(r, path, body, span)
+            if outcome[0] != "response":
+                return outcome
+            _, status, resp_body = outcome
+            value = lambda: (status, resp_body, r.replica_id)
+            if wire.response_is_unadmitted(status, resp_body):
+                return ("reject", status, value)
+            return ("final", value)
+
+        return self._route_with_retry(attempt)
+
+    def _connect_and_post(self, r: Replica, path: str, body: dict, span):
+        """Connect + POST one attempt, stopping at response HEADERS.
+        Returns ``("conn", conn, resp)`` on any HTTP response (the
+        caller owns and closes ``conn``), else the transport
+        classification of :meth:`_route_with_retry`:
+        ``("unadmitted", exc)`` — provably never received it;
+        ``("lost", exc)``       — sent, then the connection died."""
+        conn = http.client.HTTPConnection(
+            r.host, r.port, timeout=self.config.request_timeout_s)
+        try:
+            # explicit connect with its own (short) timeout so a dead
+            # replica is classified BEFORE any request bytes move
+            conn.sock = socket.create_connection(
+                (r.host, r.port), timeout=self.config.connect_timeout_s)
+            conn.sock.settimeout(self.config.request_timeout_s)
+        except OSError as e:
+            conn.close()
+            return ("unadmitted", e)
+        headers = {"Content-Type": "application/json"}
+        if span and span.trace_id:
+            headers[wire.TRACE_HEADER] = span.context.to_wire()
+        try:
+            conn.request("POST", path, body=wire.dumps(body),
+                         headers=headers)
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            return ("lost", e)
+        return ("conn", conn, resp)
+
+    def _post_once(self, r: Replica, path: str, body: dict, span):
+        """One POST attempt, read to the end of the body, classified:
+        ``("response", status, body)`` — the replica answered; else the
+        transport classifications of :meth:`_connect_and_post`."""
+        out = self._connect_and_post(r, path, body, span)
+        if out[0] != "conn":
+            return out
+        _, conn, resp = out
+        try:
+            try:
+                raw = resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                return ("lost", e)
+            try:
+                parsed = wire.loads(raw) if raw else {}
+            except wire.WireError:
+                parsed = {}
+            return ("response", resp.status, parsed)
+        finally:
+            conn.close()
+
+    # -- generate (streaming) --------------------------------------------
+    def generate(self, prompt, *, max_new_tokens: Optional[int] = None,
+                 priority: Optional[int] = None,
+                 slo_class: Optional[str] = None,
+                 deadline_s: Optional[float] = None) -> Iterator[int]:
+        """Route one generation request and stream its tokens. The
+        returned iterator yields ints as the replica emits them and ends
+        with normal exhaustion on completion — or raises the typed
+        terminal outcome AFTER the partial tokens (a replica that drains
+        or dies mid-stream delivers what it produced, then the typed
+        error; :class:`ReplicaLost` when the connection died). Dispatch
+        and the unadmitted-retry decision happen eagerly in this call;
+        consume the iterator to completion for exact accounting."""
+        body: Dict[str, Any] = {
+            "schema_version": wire.WIRE_SCHEMA_VERSION,
+            "prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
+        }
+        if max_new_tokens is not None:
+            body["max_new_tokens"] = int(max_new_tokens)
+        if priority is not None:
+            body["priority"] = int(priority)
+        if slo_class is not None:
+            body["slo_class"] = slo_class
+        if deadline_s is not None:
+            body["deadline_s"] = float(deadline_s)
+        span = _trace.root_span("router.request", route="generate")
+        self._note_submitted()
+        t0 = time.monotonic()
+        try:
+            conn, resp, replica = self._open_stream(body, span)
+        except BaseException as e:
+            self._note_outcome(self._outcome_key(e),
+                               getattr(e, "replica", ""))
+            span.end(error=e)
+            raise
+        return self._stream_tokens(conn, resp, replica, span, t0)
+
+    def _open_stream(self, body, span):
+        """Dispatch /v1/generate with the same unadmitted-retry policy
+        as submit, stopping at response HEADERS (the body streams).
+        Routed only to replicas advertising the generative capability."""
+        def attempt(r: Replica):
+            out = self._connect_and_post(r, "/v1/generate", body, span)
+            if out[0] != "conn":
+                return out
+            _, conn, resp = out
+            if resp.status == 200:
+                return ("final", lambda: (conn, resp, r))
+            try:
+                raw = resp.read()
+            except (OSError, http.client.HTTPException):
+                raw = b""
+            conn.close()
+            try:
+                parsed = wire.loads(raw) if raw else {}
+            except wire.WireError:
+                parsed = {}
+
+            def raise_typed(parsed=parsed, status=resp.status):
+                raise wire.error_from_body(
+                    parsed, f"replica {r.replica_id} status {status}")
+
+            if wire.response_is_unadmitted(resp.status, parsed):
+                return ("reject", resp.status, raise_typed)
+            return ("final", raise_typed)
+
+        return self._route_with_retry(attempt, generative=True)
+
+    def _stream_tokens(self, conn, resp, replica: Replica,
+                       span, t0: float) -> Iterator[int]:
+        streamed = 0
+        outcome_err: Optional[BaseException] = None
+        done = False
+        try:
+            while True:
+                try:
+                    line = resp.readline()
+                except (OSError, http.client.HTTPException) as e:
+                    outcome_err = ReplicaLost(
+                        f"fleet: replica {replica.replica_id} died "
+                        f"mid-stream after {streamed} token(s): {e}",
+                        replica=replica.replica_id)
+                    break
+                if not line:
+                    if not done:
+                        outcome_err = ReplicaLost(
+                            f"fleet: replica {replica.replica_id} closed "
+                            f"the stream without a terminal chunk "
+                            f"({streamed} token(s) delivered)",
+                            replica=replica.replica_id)
+                    break
+                try:
+                    obj = wire.loads(line)
+                except wire.WireError:
+                    continue
+                if obj.get("done"):
+                    done = True
+                    if obj.get("error"):
+                        outcome_err = wire.error_from_body(obj)
+                    break
+                for t in obj.get("tokens", ()):
+                    streamed += 1
+                    yield int(t)
+        finally:
+            conn.close()
+            if outcome_err is None and not done:
+                # generator closed early by the caller: the replica-side
+                # outcome still lands; fleet-wide this call is abandoned
+                outcome_err = ReplicaLost(
+                    f"fleet: generate stream abandoned by the caller "
+                    f"after {streamed} token(s)",
+                    replica=replica.replica_id)
+            if outcome_err is not None:
+                self._note_outcome(self._outcome_key(outcome_err),
+                                   replica.replica_id)
+                span.end(error=outcome_err)
+            else:
+                span.set_attribute("outcome", "completed")
+                span.set_attribute("replica", replica.replica_id)
+                span.end()
+                self._note_outcome("completed", replica.replica_id)
+                if _monitor.enabled():
+                    _monitor.histogram(
+                        "router_request_seconds",
+                        "end-to-end fleet request latency through the "
+                        "router (completed requests; p50/p99 in the "
+                        "snapshot)").observe(time.monotonic() - t0)
+            if _monitor.enabled() and streamed:
+                _monitor.counter(
+                    "fleet_stream_tokens_total",
+                    "tokens delivered over streaming fleet responses"
+                ).inc(streamed)
+        if outcome_err is not None:
+            raise outcome_err

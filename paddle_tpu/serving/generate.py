@@ -172,14 +172,16 @@ class GenerativeEngine(ServingEngine):
 
     # -- submission ------------------------------------------------------
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
-               priority: int = 0,
-               deadline_s: Optional[float] = None) -> ServingFuture:
+               priority: int = 0, deadline_s: Optional[float] = None,
+               trace_parent=None) -> ServingFuture:
         """Admit one generation request (any thread). ``prompt`` is a 1-D
         int token array (a ``[1, L]`` row is accepted); the returned
         future STREAMS tokens (``ServingFuture.stream()``) and settles
-        exactly once with the full token array or a typed error."""
+        exactly once with the full token array or a typed error.
+        ``trace_parent`` parents the request root span (fleet wire
+        propagation — see ``ServingEngine.submit``)."""
         req = self._build_gen_request(prompt, max_new_tokens, priority,
-                                      deadline_s)
+                                      deadline_s, trace_parent)
         sub = _trace.start_span("serving.submit", parent=req.span,
                                 priority=req.priority,
                                 prompt_len=len(req.prompt))
@@ -188,7 +190,7 @@ class GenerativeEngine(ServingEngine):
         return self._admit_and_enqueue(req, sub)
 
     def _build_gen_request(self, prompt, max_new_tokens, priority,
-                           deadline_s) -> _GenRequest:
+                           deadline_s, trace_parent=None) -> _GenRequest:
         prompt = np.asarray(prompt)
         if prompt.ndim == 2 and prompt.shape[0] == 1:
             prompt = prompt[0]
@@ -222,9 +224,9 @@ class GenerativeEngine(ServingEngine):
                           priority=int(priority), deadline=dl,
                           submitted=time.monotonic(), future=ServingFuture(),
                           prompt=prompt, bucket=bucket, max_new=max_new)
-        req.span = _trace.root_span("serving.request", seq=seq,
-                                    prompt_len=L, max_new=max_new,
-                                    priority=int(priority))
+        req.span = self._request_root(trace_parent, seq=seq,
+                                      prompt_len=L, max_new=max_new,
+                                      priority=int(priority))
         req.future.trace_id = req.span.trace_id
         return req
 

@@ -85,13 +85,33 @@ def enabled() -> bool:
 class SpanContext:
     """The propagatable identity of a span: ``(trace_id, span_id)``.
     Hand this (or the :class:`Span` itself) across threads/queues and
-    open children with ``span(name, parent=ctx)``."""
+    open children with ``span(name, parent=ctx)``. For crossing a
+    PROCESS boundary (the fleet tier's HTTP wire) use
+    :meth:`to_wire`/:meth:`from_wire` — ids are plain strings, so a
+    request admitted on a remote replica joins the caller's trace and
+    the flight recorder on either side names the same ``trace_id``."""
 
     __slots__ = ("trace_id", "span_id")
 
     def __init__(self, trace_id: str, span_id: str):
         self.trace_id = trace_id
         self.span_id = span_id
+
+    def to_wire(self) -> str:
+        """``"<trace_id>/<span_id>"`` — the header/body value the fleet
+        front-end ships (docs/SERVING.md wire schema)."""
+        return f"{self.trace_id}/{self.span_id}"
+
+    @staticmethod
+    def from_wire(value: Optional[str]) -> Optional["SpanContext"]:
+        """Parse :meth:`to_wire` output; None/empty/malformed values
+        return None (an untraced caller costs nothing)."""
+        if not value or "/" not in value:
+            return None
+        tid, sid = value.split("/", 1)
+        if not tid:
+            return None
+        return SpanContext(tid, sid)
 
     def __repr__(self):
         return f"SpanContext(trace={self.trace_id}, span={self.span_id})"

@@ -37,6 +37,15 @@ def pytest_configure(config):
         "markers",
         "tpu: needs a real accelerator; run with PADDLE_TPU_TESTS=1 "
         "pytest -m tpu (skipped on the CPU suite)")
+    config.addinivalue_line(
+        "markers",
+        "known_flaky(reason): order/state-dependent pre-existing flake "
+        "documented in KNOWN_FAILURES.md — the reason cross-references "
+        "the triage entry. NOT skipped and NOT retried (the tests still "
+        "run and usually pass); the marker makes tier-1 triage "
+        "mechanical: `pytest -m known_flaky --collect-only -q` lists "
+        "exactly the tests allowed to account for a ±1 swing in the "
+        "pass count")
 
 
 import pytest  # noqa: E402

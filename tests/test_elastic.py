@@ -319,6 +319,12 @@ def _reader(n_batches=8, rows=16):
     return rd
 
 
+@pytest.mark.known_flaky(
+    reason="KNOWN_FAILURES.md 'Pre-existing flake': intermittent "
+           "ReplicaDivergenceError on fc_0.b_0 after the dp=8->4 rescale "
+           "in whole-file runs only (1-ULP CPU-reduction "
+           "nondeterminism); passes standalone. Expect ±1 on the tier-1 "
+           "count; do NOT chase the gloo/1-ULP root cause here")
 def test_trainer_elastic_recovery_end_to_end(tmp_path):
     """dp=8 -> injected device loss -> automatic rescale to dp=4,
     restore from the last verified serial, exact fast-forward, rescale

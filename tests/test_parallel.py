@@ -88,6 +88,12 @@ def test_reduce_strategy_zero1_matches_allreduce():
     assert sharded, f"no dp-sharded moments found in {list(scope.vars)}"
 
 
+@pytest.mark.known_flaky(
+    reason="KNOWN_FAILURES.md 'Pre-existing flake': intermittently "
+           "misses its rtol=2e-5 pipeline-vs-plain loss comparison in "
+           "whole-SUITE runs only (1-ULP CPU-reduction amplification "
+           "over 3 SGD steps); passes standalone and with any reduced "
+           "selection. Expect ±1 on the tier-1 count")
 def test_sharded_bert_tp_dp_one_step():
     """Megatron-style tp x dp sharded BERT train step compiles and runs on
     the 8-device CPU mesh (the dryrun_multichip path, as a regression test)."""

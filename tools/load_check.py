@@ -35,7 +35,11 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
+import signal
+import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -433,6 +437,310 @@ def leg_decode_chaos(name, ci):
 
 
 # ---------------------------------------------------------------------------
+# fleet legs (--fleet): multi-PROCESS replicas + router + warm start
+# ---------------------------------------------------------------------------
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _replica_env():
+    """Subprocess env for a replica: CPU backend, ONE device (strip the
+    pytest parent's 8-device force), no inherited fault plans, and no
+    jax persistent compile cache (it would contaminate the cold-vs-warm
+    measurement — the warm-start cache under test must be the only
+    cache)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    xf = [p for p in env.get("XLA_FLAGS", "").split()
+          if not p.startswith("--xla_force_host_platform_device_count")]
+    env["XLA_FLAGS"] = " ".join(xf)
+    for k in ("FLAGS_fault_plan", "JAX_COMPILATION_CACHE_DIR",
+              "FLAGS_step_timeout_s"):
+        env.pop(k, None)
+    return env
+
+
+class _ReplicaProc:
+    """One replica subprocess: spawn, parse the ready/exit stdout
+    events, SIGTERM-drain, reap."""
+
+    def __init__(self, model: str, replica_id: str, aot_dir: str = "",
+                 log_dir: str = "."):
+        cmd = [sys.executable, "-m", "paddle_tpu.serving.fleet.replica",
+               "--model", model, "--replica-id", replica_id,
+               "--queue-depth", "256"]
+        if aot_dir:
+            cmd += ["--aot-cache", aot_dir]
+        self.replica_id = replica_id
+        self.log_path = os.path.join(log_dir, f"replica_{replica_id}.log")
+        self._log = open(self.log_path, "w")
+        self.t_spawn = time.perf_counter()
+        self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                     stderr=self._log, text=True,
+                                     cwd=_REPO_ROOT, env=_replica_env())
+        self.ready_info = None
+        self.exit_info = None
+        self.wall_to_ready = None
+        self._ready_ev = threading.Event()
+        threading.Thread(target=self._reader, daemon=True).start()
+
+    def _reader(self):
+        for line in self.proc.stdout:
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("event") == "ready":
+                self.wall_to_ready = time.perf_counter() - self.t_spawn
+                self.ready_info = obj
+                self._ready_ev.set()
+            elif obj.get("event") == "exit":
+                self.exit_info = obj
+
+    def wait_ready(self, timeout: float = 240.0):
+        if not self._ready_ev.wait(timeout):
+            raise RuntimeError(
+                f"replica {self.replica_id} did not become ready within "
+                f"{timeout:g}s (see {self.log_path})")
+        return self.ready_info
+
+    @property
+    def port(self) -> int:
+        return int(self.ready_info["port"])
+
+    def sigterm(self):
+        self.proc.send_signal(signal.SIGTERM)
+
+    def wait_exit(self, timeout: float = 60.0) -> int:
+        rc = self.proc.wait(timeout)
+        self._log.close()
+        return rc
+
+    def destroy(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(10)
+        if not self._log.closed:
+            self._log.close()
+
+
+def _drive_fleet(router, feed_fn, n_requests, n_threads,
+                 kill_at=None, kill_fn=None):
+    """Submit ``n_requests`` through the ROUTER from ``n_threads``
+    threads; after ``kill_at`` submissions have started, fire
+    ``kill_fn`` (the mid-burst SIGTERM). Returns caller-side outcome
+    counts — cross-checked against the router's fleet-wide ledger."""
+    from paddle_tpu.serving.fleet import ReplicaLost
+
+    seen = {"completed": 0, "shed": 0, "deadline": 0, "failed": 0,
+            "circuit_open": 0, "stopped": 0, "replica_lost": 0,
+            "other_error": 0}
+    lock = threading.Lock()
+    started = [0]
+    started_ev = threading.Event()
+
+    def note(key):
+        with lock:
+            seen[key] += 1
+
+    def submitter(tid):
+        for i in range(tid, n_requests, n_threads):
+            with lock:
+                started[0] += 1
+                if kill_at is not None and started[0] >= kill_at:
+                    started_ev.set()
+            try:
+                router.submit(feed_fn(rows=1, seed=i), priority=i % 3)
+                note("completed")
+            except ReplicaLost:
+                note("replica_lost")
+            except serving.Overloaded:
+                note("shed")
+            except serving.DeadlineExceeded:
+                note("deadline")
+            except serving.BatchFailed:
+                note("failed")
+            except serving.CircuitOpen:
+                note("circuit_open")
+            except serving.EngineStopped:
+                note("stopped")
+            except Exception:
+                note("other_error")
+
+    killer = None
+    if kill_fn is not None:
+        def _killer():
+            started_ev.wait(300)
+            kill_fn()
+        killer = threading.Thread(target=_killer, daemon=True)
+        killer.start()
+    threads = [threading.Thread(target=submitter, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    if killer is not None:
+        killer.join(60)
+    seen["submitted"] = n_requests
+    seen["terminal"] = sum(v for k, v in seen.items()
+                           if k not in ("submitted", "terminal"))
+    return seen
+
+
+def _mlp_feed(rows=1, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"img": rng.rand(rows, 784).astype(np.float32),
+            "label": np.zeros((rows, 1), np.int64)}
+
+
+def leg_fleet(name, ci, log_dir="."):
+    """The 2-replica fleet gate: r0 starts COLD and populates the
+    warm-start cache; r1 starts from it WARM (the measured cold-vs-warm
+    pair). Both serve a multi-thread burst through the router; r0 is
+    SIGTERMed mid-burst — it drains everything it admitted (typed, exact)
+    while the router routes away and retries only unadmitted dispatches
+    on r1. Requirements: exact fleet-wide accounting, zero untyped
+    errors, zero admitted-request losses, a clean victim exit, and a
+    measurably faster warm start."""
+    from paddle_tpu.serving.fleet import FleetRouter, Replica
+
+    aot_dir = tempfile.mkdtemp(prefix="paddle_tpu_fleet_aot_")
+    r0 = r1 = None
+    try:
+        r0 = _ReplicaProc("mlp_tiny", "r0", aot_dir, log_dir)
+        cold = dict(r0.wait_ready())
+        r1 = _ReplicaProc("mlp_tiny", "r1", aot_dir, log_dir)
+        warm = dict(r1.wait_ready())
+
+        router = FleetRouter([Replica("r0", "127.0.0.1", r0.port),
+                              Replica("r1", "127.0.0.1", r1.port)])
+        n = 36 if ci else 120
+        with router:
+            seen = _drive_fleet(router, _mlp_feed, n_requests=n,
+                                n_threads=4, kill_at=n // 3,
+                                kill_fn=r0.sigterm)
+            acct = router.accounting()
+        rc = r0.wait_exit(60)
+        victim = r0.exit_info or {}
+        vacct = victim.get("accounting", {})
+        r1.sigterm()
+        r1.wait_exit(60)
+        survivor = (r1.exit_info or {}).get("accounting", {})
+
+        lat = monitor.metric_value("router_request_seconds", default=None)
+        cold_cache = cold.get("aot_cache", {})
+        warm_cache = warm.get("aot_cache", {})
+        checks = {
+            "exact_fleet_accounting": bool(acct["exact"]),
+            "every_submit_terminal": seen["terminal"] == seen["submitted"],
+            "all_completed": seen["completed"] == n,
+            "no_untyped_errors": seen["other_error"] == 0,
+            "nothing_admitted_lost":
+                seen["replica_lost"] == 0 and seen["stopped"] == 0
+                and seen["failed"] == 0,
+            "victim_exit_clean": rc == 0 and bool(vacct.get("exact"))
+                and vacct.get("pending", -1) == 0,
+            "victim_shed_nothing_admitted":
+                vacct.get("shed", -1) == 0 and vacct.get("failed", -1) == 0,
+            "victim_served_before_drain": vacct.get("completed", 0) > 0,
+            "survivor_served": survivor.get("completed", 0) > 0,
+            "latency_histogram_present":
+                isinstance(lat, dict) and lat["count"] > 0
+                and lat["p50"] is not None and lat["p99"] is not None,
+            # warm start: the restarted-cold-with-cache replica must be
+            # measurably faster to ready than the cold baseline
+            "cold_populated_cache": cold_cache.get("hits") == 0
+                and cold_cache.get("saves", 0) >= 1,
+            "warm_loaded_from_cache": warm_cache.get("hits", 0) >= 1
+                and warm_cache.get("misses", 1) == 0,
+            "warm_up_measurably_faster":
+                warm["warm_up_s"] < 0.6 * cold["warm_up_s"],
+            "warm_ready_faster":
+                warm["time_to_ready_s"] < cold["time_to_ready_s"],
+        }
+        warmstart = {
+            "cold": {"time_to_ready_s": cold["time_to_ready_s"],
+                     "warm_up_s": cold["warm_up_s"],
+                     "wall_to_ready_s": r0.wall_to_ready,
+                     "aot_cache": cold_cache},
+            "warm": {"time_to_ready_s": warm["time_to_ready_s"],
+                     "warm_up_s": warm["warm_up_s"],
+                     "wall_to_ready_s": r1.wall_to_ready,
+                     "aot_cache": warm_cache},
+            "ready_speedup":
+                cold["time_to_ready_s"] / max(warm["time_to_ready_s"],
+                                              1e-9),
+            "warm_up_speedup":
+                cold["warm_up_s"] / max(warm["warm_up_s"], 1e-9),
+        }
+        return {"name": name, "ok": all(checks.values()), "requests": n,
+                "caller_view": seen, "router_accounting": acct,
+                "victim_accounting": vacct,
+                "survivor_accounting": survivor,
+                "checks": checks, "warmstart": warmstart,
+                "latency": lat,
+                "why": "kill one of two replicas mid-burst: the fleet "
+                       "completes 100% of admitted requests with exactly-"
+                       "one-outcome accounting, and a warm-start replica "
+                       "is measurably faster to ready"}
+    finally:
+        for r in (r0, r1):
+            if r is not None:
+                r.destroy()
+        shutil.rmtree(aot_dir, ignore_errors=True)
+
+
+def leg_fleet_negative(name, ci, log_dir="."):
+    """--fleet --negative-control: the router runs with drain honoring
+    AND the unadmitted-sibling retry disabled (the two behaviors the
+    kill scenario exercises). After the mid-burst SIGTERM the router
+    keeps dispatching to the draining/dead replica, so requests reach
+    typed stopped/replica-lost outcomes — the gate MUST fail."""
+    from paddle_tpu.serving.fleet import (FleetRouter, Replica,
+                                          RouterConfig)
+
+    aot_dir = tempfile.mkdtemp(prefix="paddle_tpu_fleet_aot_")
+    r0 = r1 = None
+    try:
+        r0 = _ReplicaProc("mlp_tiny", "r0", aot_dir, log_dir)
+        r0.wait_ready()
+        r1 = _ReplicaProc("mlp_tiny", "r1", aot_dir, log_dir)
+        r1.wait_ready()
+        router = FleetRouter(
+            [Replica("r0", "127.0.0.1", r0.port),
+             Replica("r1", "127.0.0.1", r1.port)],
+            config=RouterConfig(honor_drain=False,
+                                retry_unadmitted=False))
+        n = 36 if ci else 120
+        with router:
+            seen = _drive_fleet(router, _mlp_feed, n_requests=n,
+                                n_threads=4, kill_at=n // 3,
+                                kill_fn=r0.sigterm)
+            acct = router.accounting()
+        r1.sigterm()
+        checks = {
+            "exact_fleet_accounting": bool(acct["exact"]),
+            "every_submit_terminal": seen["terminal"] == seen["submitted"],
+            "all_completed": seen["completed"] == n,
+            "no_untyped_errors": seen["other_error"] == 0,
+            "nothing_admitted_lost":
+                seen["replica_lost"] == 0 and seen["stopped"] == 0
+                and seen["failed"] == 0,
+        }
+        return {"name": name, "ok": all(checks.values()), "requests": n,
+                "caller_view": seen, "router_accounting": acct,
+                "checks": checks,
+                "why": "drain honoring + unadmitted retry disabled: the "
+                       "kill scenario must trip the gate"}
+    finally:
+        for r in (r0, r1):
+            if r is not None:
+                r.destroy()
+        shutil.rmtree(aot_dir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -454,12 +762,67 @@ def main(argv=None) -> int:
                          "recompiles, tokens/s + inter-token p50/p99 in "
                          "the artifact) and a chaos sub-leg that kills one "
                          "in-flight batch (affected streams settle typed)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the multi-PROCESS fleet gate instead: two "
+                         "replica subprocesses behind the router, one "
+                         "SIGTERMed mid-burst (drain honored, unadmitted "
+                         "retry, exact fleet-wide accounting) plus the "
+                         "cold-vs-warm AOT-cache startup measurement. "
+                         "With --negative-control the router runs without "
+                         "drain honoring/retry and the gate must FAIL")
+    ap.add_argument("--log-dir", default=".",
+                    help="where fleet replica stderr logs land")
     args = ap.parse_args(argv)
     ci = args.ci or args.check
 
     monitor.reset()
     legs = []
     t0 = time.time()
+    if args.fleet:
+        if args.negative_control:
+            legs.append(leg_fleet_negative("fleet_no_drain_honor", ci,
+                                           args.log_dir))
+        else:
+            legs.append(leg_fleet("fleet_kill_one_replica", ci,
+                                  args.log_dir))
+        gate_ok = all(l["ok"] for l in legs)
+        for l in legs:
+            status = "ok" if l["ok"] else "MISS"
+            print(f"[{status}] {l['name']}: {l['requests']} requests -> "
+                  + ", ".join(f"{k}={v}" for k, v in
+                              sorted(l["caller_view"].items()) if v))
+            for k, v in sorted(l.get("checks", {}).items()):
+                if not v:
+                    print(f"       FAILED check: {k}")
+            ws = l.get("warmstart")
+            if ws:
+                print(f"warm start: cold ready "
+                      f"{ws['cold']['time_to_ready_s']:.2f}s "
+                      f"(warm_up {ws['cold']['warm_up_s']:.2f}s) -> warm "
+                      f"{ws['warm']['time_to_ready_s']:.2f}s "
+                      f"(warm_up {ws['warm']['warm_up_s']:.2f}s), "
+                      f"speedup {ws['ready_speedup']:.1f}x ready / "
+                      f"{ws['warm_up_speedup']:.1f}x warm-up")
+            lat = l.get("latency")
+            if isinstance(lat, dict) and lat.get("count"):
+                print(f"fleet latency: count={lat['count']} "
+                      f"p50={lat['p50'] * 1e3:.1f}ms "
+                      f"p99={lat['p99'] * 1e3:.1f}ms")
+        print(f"serving gate ({time.time() - t0:.1f}s) -> "
+              f"{'ok' if gate_ok else 'FAIL'}")
+        if args.json:
+            with open(args.json, "w", encoding="utf-8") as f:
+                json.dump({
+                    "legs": legs,
+                    "warmstart": next((l.get("warmstart") for l in legs
+                                       if l.get("warmstart")), None),
+                    "snapshot": monitor.snapshot(),
+                    "check": {"status": "ok" if gate_ok else "fail",
+                              "negative_control":
+                                  bool(args.negative_control)},
+                }, f, indent=2, default=str)
+            print(f"fleet artifact written to {args.json}")
+        return 0 if gate_ok else 1
     if args.negative_control:
         # only the chaos leg matters: with shedding disabled the
         # overload_was_shed requirement must trip the gate
