@@ -146,6 +146,34 @@ if ! grep -q -- "-> FAIL" "$FLEET_NEG_LOG"; then
   exit 1
 fi
 
+echo "== fleet self-healing gate (supervisor + bisection + wire chaos: under"
+echo "   injected drop/stall/corrupt wire faults a stalling replica is ejected"
+echo "   by the router's transport breaker and unadmitted faults retry on the"
+echo "   sibling; a poison request co-batched with innocents is isolated by"
+echo "   bisection (innocents complete bit-exact, culprit typed PoisonRequest,"
+echo "   repeat offender quarantined); a SIGKILLed replica restarts warm under"
+echo "   the same id within its backoff budget; a forced crash loop retires"
+echo "   with a typed ReplicaCrashLoop)"
+JAX_PLATFORMS=cpu python tools/load_check.py --ci --fleet-chaos \
+  --log-dir "${CI_ARTIFACT_DIR:-.}" \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_fleet_chaos_report.json" | tail -10
+echo "== fleet self-healing negative control (supervisor restarts + bisection"
+echo "   disabled: innocents must die with the poison and the killed replica"
+echo "   must stay dead — the gate must FAIL)"
+FLEET_CHAOS_NEG_LOG="${CI_ARTIFACT_DIR:-.}/ci_fleet_chaos_negative.log"
+if JAX_PLATFORMS=cpu python tools/load_check.py --ci --fleet-chaos \
+     --negative-control --log-dir "${CI_ARTIFACT_DIR:-.}" \
+     > "$FLEET_CHAOS_NEG_LOG" 2>&1; then
+  echo "load_check --fleet-chaos did NOT fail with self-healing disabled" >&2
+  exit 1
+fi
+# non-zero exit must be the gate tripping, not the harness crashing
+if ! grep -q -- "-> FAIL" "$FLEET_CHAOS_NEG_LOG"; then
+  echo "fleet-chaos negative control exited non-zero WITHOUT tripping the gate:" >&2
+  tail -20 "$FLEET_CHAOS_NEG_LOG" >&2
+  exit 1
+fi
+
 echo "== trace gate (paddle_tpu.trace: every request in exactly one complete"
 echo "   trace, flight-recorder dumps on injected batch fault + watchdog hang,"
 echo "   cost-model FLOPs within 10% of analytic, near-zero off overhead;"

@@ -148,6 +148,12 @@ _DEFS: Dict[str, tuple] = {
     "fault_seed": (int, 0,
                    "seed for probabilistic fault-plan rules and retry "
                    "jitter — the same plan+seed replays identically"),
+    "fault_stall_s": (float, 5.0,
+                      "duration of the 'stall' data-plane wire fault "
+                      "action (resilience.faults wire_connect/"
+                      "wire_response/wire_stream sites): the injected "
+                      "sleep that models a stalling-but-listening peer "
+                      "the router's per-replica breaker must eject"),
     "retry_max_attempts": (int, 3,
                            "attempts (first try included) for transient "
                            "failures at the compile/device_put sites; 1 "
@@ -211,6 +217,26 @@ _DEFS: Dict[str, tuple] = {
                                       "in degraded mode, requests with "
                                       "priority below this are shed at "
                                       "admission with typed Overloaded"),
+    "serving_bisect_depth": (int, 0,
+                             "poison-request isolation (docs/SERVING.md): "
+                             "when a batch fails with a state-safe error, "
+                             "re-dispatch it as bisected halves up to this "
+                             "depth until the culprit request is isolated "
+                             "— innocents complete with correct results, "
+                             "the culprit settles typed PoisonRequest and "
+                             "its feed fingerprint is quarantined. 0 "
+                             "disables (default): the whole batch fails "
+                             "typed BatchFailed as before. Failures that "
+                             "may have corrupted device state (watchdog "
+                             "timeout, device loss, consumed donated "
+                             "buffers) always fail the whole batch"),
+    "serving_bisect_quarantine": (int, 64,
+                                  "bounded count of poison feed "
+                                  "fingerprints remembered per engine; a "
+                                  "quarantined fingerprint is shed at "
+                                  "admission (typed Overloaded, reason "
+                                  "poison_quarantine) instead of failing "
+                                  "another batch. Oldest evicted"),
     "auto_recompute": (bool, False,
                        "automatic rematerialisation: on Executor.run / "
                        "run_chained / CompiledProgram, training programs "

@@ -42,7 +42,25 @@ site           probed where
 ``overload``   inside serving admission control — a fired rule forces the
                request to be rejected ``Overloaded`` exactly as if the
                queue were full (synthetic pressure for the load gate)
+``wire_connect``  fleet router, before a dispatch connection is opened
+               (``fleet.router._connect_and_post``) — the request provably
+               has NOT been sent yet, so a fired fault here exercises the
+               unadmitted-retry path
+``wire_response`` around one HTTP response body: fleet front-end before it
+               writes (``_respond_best_effort``) AND fleet router before it
+               reads (``_post_once``) — a fault here models the wire dying
+               or lying AFTER the request may have been admitted
+``wire_stream``   around one streaming ND-JSON chunk: front-end ``_chunk``
+               and router ``_stream_tokens`` — mid-generation wire chaos
 =============  ==============================================================
+
+The three ``wire_*`` sites are probed via :func:`fault_action` (not
+:func:`fault_point`) and accept three extra **data-plane actions** the
+call site performs itself: ``drop`` (sever the connection), ``stall``
+(sleep ``FLAGS_fault_stall_s`` — an interruptible trickle that models a
+stalling-but-listening peer) and ``corrupt`` (mangle the payload bytes).
+Exception actions still work at wire sites; the data-plane actions are
+refused at non-wire sites at parse time.
 
 Plan grammar (``FLAGS_fault_plan``, comma-separated rules)::
 
@@ -74,13 +92,21 @@ import random
 import re
 from typing import Dict, List, Optional
 
-__all__ = ["FaultPlan", "InjectedFault", "fault_point", "install_plan",
-           "clear_plan", "fault_plan_guard", "active_plan", "SITES"]
+__all__ = ["FaultPlan", "InjectedFault", "fault_point", "fault_action",
+           "stall", "install_plan", "clear_plan", "fault_plan_guard",
+           "active_plan", "SITES", "WIRE_SITES", "DATA_ACTIONS"]
 
 logger = logging.getLogger("paddle_tpu.resilience")
 
 SITES = ("compile", "device_put", "step", "ckpt_write", "shard_write",
-         "hang", "enqueue", "batch_dispatch", "overload", "device_lost")
+         "hang", "enqueue", "batch_dispatch", "overload", "device_lost",
+         "wire_connect", "wire_response", "wire_stream")
+
+# sites whose faults are performed by the CALL SITE (fault_action): the
+# fleet wire layer can drop/stall/corrupt, which a raised exception
+# cannot express
+WIRE_SITES = frozenset({"wire_connect", "wire_response", "wire_stream"})
+DATA_ACTIONS = ("drop", "stall", "corrupt")
 
 # injected exceptions carry this mixin so retry/give-up handlers can tell a
 # scripted fault from a real infrastructure error (real errors keep their
@@ -150,10 +176,18 @@ class FaultPlan:
             if site not in SITES:
                 raise ValueError(f"FLAGS_fault_plan: unknown site '{site}' "
                                  f"(known: {', '.join(SITES)})")
-            if action not in ("kill", "hang") and action not in _BASES:
+            if action not in ("kill", "hang") \
+                    and action not in DATA_ACTIONS \
+                    and action not in _BASES:
                 raise ValueError(
                     f"FLAGS_fault_plan: unknown action '{action}' (known: "
-                    f"kill, hang, {', '.join(sorted(_BASES))})")
+                    f"kill, hang, {', '.join(DATA_ACTIONS)}, "
+                    f"{', '.join(sorted(_BASES))})")
+            if action in DATA_ACTIONS and site not in WIRE_SITES:
+                raise ValueError(
+                    f"FLAGS_fault_plan: action '{action}' is a data-plane "
+                    f"wire action — only the wire sites "
+                    f"({', '.join(sorted(WIRE_SITES))}) can perform it")
             rule = _Rule(site=site, action=action)
             if when.startswith("@"):
                 rule.at = int(when[1:])
@@ -167,24 +201,65 @@ class FaultPlan:
     def active(self) -> bool:
         return bool(self.rules)
 
-    def hit(self, site: str) -> None:
-        """Record one pass through ``site``; perform the scheduled action if
-        a rule fires (raise an injected exception or kill the process).
-        Counting and rule evaluation run under the plan lock; the action
-        itself runs outside it (a ``hang`` must never stall other threads'
-        probes)."""
+    def _fire(self, site: str):
+        """Record one pass through ``site`` under the plan lock; returns
+        ``(rule, hit_number)`` when a rule fired, else ``None``. The
+        ``fired`` audit trail records every fired rule (including the
+        data-plane wire actions the call site performs itself)."""
         rules = self.rules.get(site)
         if not rules:
-            return
+            return None
         with self._lock:
             self.hits[site] = k = self.hits.get(site, 0) + 1
             fired_rule = next(
                 (r for r in rules if r.fires(k, self._rng)), None)
             if fired_rule is not None:
                 self.fired.append((site, k, fired_rule.action))
-        if fired_rule is None:
+        return None if fired_rule is None else (fired_rule, k)
+
+    def hit(self, site: str) -> None:
+        """Probe ``site``; perform the scheduled action if a rule fires
+        (raise an injected exception or kill the process). Counting and
+        rule evaluation run under the plan lock; the action itself runs
+        outside it (a ``hang`` must never stall other threads' probes)."""
+        fired = self._fire(site)
+        if fired is None:
             return
-        rule = fired_rule
+        rule, k = fired
+        if rule.action in DATA_ACTIONS:
+            # a data-plane action reaching a raise-style probe would be a
+            # plan/call-site mismatch (parse validation pins these to the
+            # wire sites, which probe via action()); log, never crash
+            logger.warning("fault_plan: data action '%s' fired at "
+                           "fault_point site '%s' — ignored (probe via "
+                           "fault_action)", rule.action, site)
+            return
+        self._perform(rule, site, k)
+
+    def action(self, site: str) -> Optional[str]:
+        """Probe ``site`` for the wire call sites: a fired data-plane
+        action (``drop``/``stall``/``corrupt``) is RETURNED for the call
+        site to perform; exception/kill/hang actions are performed here
+        exactly like :meth:`hit`. ``None`` = nothing fired."""
+        fired = self._fire(site)
+        if fired is None:
+            return None
+        rule, k = fired
+        if rule.action in DATA_ACTIONS:
+            from .. import monitor as _monitor
+
+            if _monitor.enabled():
+                _monitor.counter(
+                    "resilience_faults_injected_total",
+                    "faults fired by the FLAGS_fault_plan schedule").labels(
+                    site=site, action=rule.action).inc()
+            logger.warning("fault_plan: wire action '%s' at site '%s' "
+                           "(hit #%d)", rule.action, site, k)
+            return rule.action
+        self._perform(rule, site, k)
+        return None
+
+    def _perform(self, rule: _Rule, site: str, k: int) -> None:
         from .. import monitor as _monitor
 
         if _monitor.enabled():
@@ -251,6 +326,36 @@ def fault_point(site: str) -> None:
     plan = active_plan()
     if plan is not None:
         plan.hit(site)
+
+
+def fault_action(site: str) -> Optional[str]:
+    """The wire-site probe: returns a fired data-plane action
+    (``drop``/``stall``/``corrupt``) for the call site to perform, raises
+    injected exceptions exactly like :func:`fault_point`, or returns
+    ``None`` when nothing fired."""
+    plan = active_plan()
+    if plan is None:
+        return None
+    return plan.action(site)
+
+
+def stall(seconds: Optional[float] = None) -> None:
+    """The ``stall`` wire action: sleep ``FLAGS_fault_stall_s`` (or an
+    explicit ``seconds``) in short slices, so signal delivery and
+    interpreter shutdown stay responsive while a stalling peer is being
+    modeled."""
+    import time
+
+    if seconds is None:
+        from ..flags import flag
+
+        seconds = float(flag("fault_stall_s"))
+    deadline = time.monotonic() + max(0.0, seconds)
+    while True:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            return
+        time.sleep(min(0.05, left))
 
 
 class fault_plan_guard:

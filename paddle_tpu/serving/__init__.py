@@ -31,8 +31,8 @@ from ..resilience.deadline import Deadline, DeadlineExceeded
 from .breaker import CircuitBreaker
 from .engine import (HEALTH_SCHEMA_KEYS, HEALTH_SCHEMA_VERSION,
                      BatchFailed, CircuitOpen, EngineStopped, Overloaded,
-                     ServingConfig, ServingEngine, ServingError,
-                     ServingFuture)
+                     PoisonRequest, ServingConfig, ServingEngine,
+                     ServingError, ServingFuture)
 from .generate import GenerationConfig, GenerativeEngine
 from . import fleet
 
@@ -41,7 +41,7 @@ __all__ = [
     "Deadline", "GenerativeEngine", "GenerationConfig",
     # typed terminal outcomes
     "ServingError", "Overloaded", "CircuitOpen", "BatchFailed",
-    "EngineStopped", "DeadlineExceeded",
+    "PoisonRequest", "EngineStopped", "DeadlineExceeded",
     # the frozen health()/ready() wire contract (docs/SERVING.md)
     "HEALTH_SCHEMA_VERSION", "HEALTH_SCHEMA_KEYS",
     # the network tier (front-end, router, wire schema, replica worker)

@@ -40,8 +40,16 @@ HALF_OPEN = "half_open"
 
 class CircuitBreaker:
     def __init__(self, threshold: int, cooldown_s: float,
-                 name: str = "", seed: int = 0):
+                 name: str = "", seed: int = 0,
+                 emit_transitions: bool = True):
+        """``emit_transitions=False`` suppresses the
+        ``serving_breaker_transitions_total`` emission — for reusers of
+        the state machine that own their OWN transition metric (the
+        fleet router's per-replica transport breakers emit
+        ``router_breaker_transitions_total`` instead; the serving metric
+        must keep meaning 'bucket breakers' as documented)."""
         self.name = name
+        self.emit_transitions = emit_transitions
         self.threshold = max(1, int(threshold))
         # the cooldown ladder IS a retry backoff: attempt k of the policy
         # = the k-th consecutive re-open of this bucket
@@ -99,7 +107,7 @@ class CircuitBreaker:
 
         self._state = to
         self.transitions += 1
-        if _monitor.enabled():
+        if self.emit_transitions and _monitor.enabled():
             _monitor.counter(
                 "serving_breaker_transitions_total",
                 "circuit-breaker state changes by target state").labels(

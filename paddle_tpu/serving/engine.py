@@ -40,6 +40,15 @@ Robustness surface (docs/SERVING.md):
   engine keeps serving. The ``hang`` fault site fires inside the
   executor's watchdog-armed section, and the watchdog can now break
   non-main threads, so a slow batch dies diagnosed.
+* **poison-request bisection** — with ``FLAGS_serving_bisect_depth > 0``
+  a failed batch whose error is state-safe is re-dispatched as bisected
+  halves (bounded depth, per-member deadlines still enforced) until the
+  culprit is isolated: innocents complete with correct results, the
+  culprit settles typed :class:`PoisonRequest` and its feed fingerprint
+  enters a bounded quarantine that sheds repeat offenders at admission.
+  Failures that may have corrupted device state (watchdog timeout,
+  device loss, consumed donated buffers) still fail the whole batch —
+  never a re-dispatch on corrupted state.
 
 Fault sites for the chaos gate: ``enqueue`` (submission), ``overload``
 (forced shed), ``batch_dispatch`` (batch failure) + the executor's own
@@ -69,7 +78,7 @@ from .breaker import CircuitBreaker
 
 __all__ = ["ServingConfig", "ServingEngine", "ServingFuture",
            "ServingError", "Overloaded", "CircuitOpen", "BatchFailed",
-           "EngineStopped", "DeadlineExceeded",
+           "PoisonRequest", "EngineStopped", "DeadlineExceeded",
            "HEALTH_SCHEMA_VERSION", "HEALTH_SCHEMA_KEYS"]
 
 logger = logging.getLogger("paddle_tpu.serving")
@@ -131,6 +140,20 @@ class BatchFailed(ServingError):
     serving."""
 
 
+class PoisonRequest(BatchFailed):
+    """Bisection isolated THIS request as the culprit of its batch's
+    failure (``FLAGS_serving_bisect_depth``): re-dispatched alone (or as
+    the sole survivor of bisected halves) it still failed, while its
+    former batch mates completed. ``__cause__`` is the underlying error;
+    ``fingerprint`` names the quarantined feed — repeat submissions of
+    the same feed are shed at admission (``Overloaded``,
+    ``reason="poison_quarantine"``) instead of failing another batch."""
+
+    def __init__(self, msg: str, fingerprint: str = ""):
+        self.fingerprint = fingerprint
+        super().__init__(msg)
+
+
 class EngineStopped(ServingError):
     """The engine is not running (never started, or stopped without
     drain while this request was queued)."""
@@ -163,6 +186,8 @@ class ServingConfig:
     degrade_after_s: Optional[float] = None
     recover_after_s: Optional[float] = None
     degraded_min_priority: Optional[int] = None
+    bisect_depth: Optional[int] = None          # 0 = no poison bisection
+    bisect_quarantine: Optional[int] = None
 
     def resolve(self) -> "ServingConfig":
         r = ServingConfig(
@@ -186,6 +211,10 @@ class ServingConfig:
                 self.recover_after_s, "serving_recover_after_s")),
             degraded_min_priority=int(_flag_default(
                 self.degraded_min_priority, "serving_degraded_min_priority")),
+            bisect_depth=int(_flag_default(self.bisect_depth,
+                                           "serving_bisect_depth")),
+            bisect_quarantine=int(_flag_default(
+                self.bisect_quarantine, "serving_bisect_quarantine")),
         )
         if r.max_batch < 1:
             raise ValueError(f"serving: max_batch must be >= 1, got "
@@ -314,6 +343,9 @@ class _Request:
     deadline: Optional[Deadline]
     submitted: float
     future: ServingFuture
+    # sha256 feed fingerprint (computed only when poison bisection is on:
+    # the quarantine's key, stable across resubmissions of one feed)
+    fp: str = ""
     # root span of this request's trace (trace.NOOP_SPAN when off) and
     # the in-flight dispatch child opened by the dispatch thread
     span: Any = _trace.NOOP_SPAN
@@ -380,11 +412,19 @@ class ServingEngine:
         # the crash guard to settle in-flight requests typed)
         self._current_batch: List[_Request] = []
 
+        # bounded poison quarantine (guarded by _lock): feed fingerprint
+        # -> times shed at admission since isolation; oldest evicted at
+        # config.bisect_quarantine entries
+        from collections import OrderedDict
+
+        self._quarantine: "OrderedDict[str, int]" = OrderedDict()
+
         # exact request accounting (guarded by _lock): the load gate's
         # ground truth. submitted == sum(all other keys) + pending queue
         self._acct = {"submitted": 0, "completed": 0, "failed": 0,
-                      "shed": 0, "deadline_exceeded": 0, "circuit_open": 0,
-                      "rejected_fault": 0, "rejected_stopped": 0}
+                      "poisoned": 0, "shed": 0, "deadline_exceeded": 0,
+                      "circuit_open": 0, "rejected_fault": 0,
+                      "rejected_stopped": 0}
         # last N terminal outcomes with their trace ids (accounting()):
         # a failed load_check leg names the exact requests that missed
         self._recent_outcomes: deque = deque(maxlen=64)
@@ -569,6 +609,12 @@ class ServingEngine:
         req = _Request(seq=seq, feed=vals, nrows=nrows, sig=sig,
                        priority=int(priority), deadline=dl,
                        submitted=time.monotonic(), future=ServingFuture())
+        if self.config.bisect_depth > 0 and self._quarantine:
+            # the fingerprint is only needed eagerly for the admission
+            # quarantine lookup; with an empty quarantine the submit hot
+            # path skips the hash (the poison-settle path computes it
+            # lazily when a culprit is isolated)
+            req.fp = self._feed_fingerprint(vals)
         # one trace per request, minted at submit: the root span stays
         # open across the queue + the dispatch thread and is settled with
         # the typed terminal outcome (exactly once, like the accounting).
@@ -596,6 +642,28 @@ class ServingEngine:
             self._shed_locked("injected", now)
             raise Overloaded("serving: injected overload pressure "
                              "(FLAGS_fault_plan)", reason="injected") from e
+        if self._quarantine and not req.fp \
+                and self.config.bisect_depth > 0:
+            # the lazy build-time hash saw an empty quarantine, but one
+            # filled up since (e.g. this very feed's first copy was just
+            # isolated on the dispatch thread): close the race under the
+            # lock so a known-poison feed can never slip past admission
+            req.fp = self._feed_fingerprint(req.feed)
+        if req.fp and req.fp in self._quarantine:
+            # an isolated poison feed resubmitted: shed it at admission
+            # instead of letting it fail (and bisect) another batch
+            self._quarantine[req.fp] += 1
+            self._quarantine.move_to_end(req.fp)
+            repeats = self._quarantine[req.fp]
+            self._shed_locked("poison_quarantine", now)
+            if _monitor.enabled():
+                _monitor.counter(
+                    "serving_bisect_quarantine_sheds_total",
+                    "quarantined poison feeds shed at admission").inc()
+            raise Overloaded(
+                f"serving: feed fingerprint {req.fp} is quarantined "
+                f"(isolated as a poison request; shed {repeats} time(s) "
+                f"since)", reason="poison_quarantine")
         if len(self._queue) >= self.config.queue_depth:
             self._shed_locked("queue_full", now)
             raise Overloaded(
@@ -805,31 +873,41 @@ class ServingEngine:
         self._gauge_depth_locked()
         return batch
 
-    def _run_batch(self, batch: List[_Request]) -> None:
+    def _run_batch(self, batch: List[_Request], depth: int = 0,
+                   ctx: Optional[dict] = None) -> None:
+        """Execute one coalesced batch. ``depth > 0`` is a bisection
+        re-dispatch (``_resolve_failed_batch``): the breaker, the
+        ``batch_dispatch`` fault probe and the flight-recorder incident
+        belong to the ORIGINAL depth-0 dispatch only — a re-dispatched
+        half is already inside one failure's blast-radius accounting.
+        ``ctx`` is the depth-0 resolution's shared bisection context
+        (poison candidates are deferred into it)."""
         rows = sum(r.nrows for r in batch)
         padded = self._bucket_size(rows)
         sig = batch[0].sig
         bucket = (sig, padded)
-        br = self._breakers.get(bucket)
-        if br is None:
-            br = CircuitBreaker(self.config.breaker_threshold,
-                                self.config.breaker_cooldown_s,
-                                name=self._bucket_label(bucket))
-            with self._lock:   # health() snapshots the dict concurrently
-                self._breakers[bucket] = br
-        verdict = br.allow()
-        if verdict == "no":
-            for r in batch:
-                self._settle_error(
-                    r, "circuit_open",
-                    CircuitOpen(
-                        f"serving: bucket {br.name} quarantined "
-                        f"(state={br.state}, "
-                        f"{br.snapshot()['consecutive_failures']} "
-                        f"consecutive failures)", bucket=br.name),
-                    dispatched=True)
-            self._gauge_open_buckets()
-            return
+        br = None
+        if depth == 0:
+            br = self._breakers.get(bucket)
+            if br is None:
+                br = CircuitBreaker(self.config.breaker_threshold,
+                                    self.config.breaker_cooldown_s,
+                                    name=self._bucket_label(bucket))
+                with self._lock:   # health() snapshots the dict concurrently
+                    self._breakers[bucket] = br
+            verdict = br.allow()
+            if verdict == "no":
+                for r in batch:
+                    self._settle_error(
+                        r, "circuit_open",
+                        CircuitOpen(
+                            f"serving: bucket {br.name} quarantined "
+                            f"(state={br.state}, "
+                            f"{br.snapshot()['consecutive_failures']} "
+                            f"consecutive failures)", bucket=br.name),
+                        dispatched=True)
+                self._gauge_open_buckets()
+                return
         # one batch span (its own trace) linking the member request
         # traces; each request gets a 'serving.dispatch' child under ITS
         # root carrying the batch ids — submit-thread -> dispatch-thread
@@ -839,15 +917,17 @@ class ServingEngine:
         if _trace.enabled():
             batch_span = _trace.root_span(
                 "serving.batch", bucket=label, rows=rows, padded=padded,
-                requests=len(batch),
+                requests=len(batch), bisect_depth=depth,
                 request_traces=",".join(r.span.trace_id for r in batch))
             for r in batch:
                 r.dispatch_span = _trace.start_span(
                     "serving.dispatch", parent=r.span, bucket=label,
+                    bisect_depth=depth,
                     batch_trace=batch_span.trace_id,
                     batch_span=batch_span.span_id)
         try:
-            _faults.fault_point("batch_dispatch")
+            if depth == 0:
+                _faults.fault_point("batch_dispatch")
             feed = self._pad_feed(batch, rows, padded)
             t0 = time.perf_counter()
             # executor/compile/retry spans nest under the batch span
@@ -857,40 +937,34 @@ class ServingEngine:
                                      scope=self._scope)
             batch_s = time.perf_counter() - t0
         except Exception as e:   # typed per-batch isolation; engine lives
-            br.record_failure()
-            self._gauge_open_buckets()
+            if br is not None:
+                br.record_failure()
+                self._gauge_open_buckets()
             if _monitor.enabled():
                 _monitor.counter(
                     "serving_batches_total",
                     "dispatched batches by result").labels(
                     result="failed").inc()
             logger.warning(
-                "serving: batch of %d request(s) on bucket %s failed "
-                "(%s: %s) — failing those requests, engine continues",
-                len(batch), self._bucket_label(bucket), type(e).__name__, e)
+                "serving: batch of %d request(s) on bucket %s failed at "
+                "bisect depth %d (%s: %s)",
+                len(batch), label, depth, type(e).__name__, e)
             batch_span.set_attribute("outcome", "failed")
             batch_span.end(error=e)
-            for r in batch:
-                # one instance per future: concurrent result() raises
-                # would otherwise interleave __traceback__ on a shared
-                # exception object
-                err = BatchFailed(
-                    f"serving: batch failed on bucket "
-                    f"{self._bucket_label(bucket)}: "
-                    f"{type(e).__name__}: {e}")
-                err.__cause__ = e
-                self._settle_error(r, "failed", err, dispatched=True)
-            # flight recorder: the incident ships with the failed
-            # requests' full span chains (settled above, so the terminal
-            # outcomes are already in the ring)
-            _trace.record_incident(
-                "batch_failed", error=e,
-                context=batch[0].span if batch else None,
-                detail=f"bucket {self._bucket_label(bucket)}, "
-                       f"{len(batch)} request(s)")
+            self._resolve_failed_batch(batch, e, depth, label, ctx)
+            if br is not None and any(m.future.done()
+                                      and m.future._error is None
+                                      for m in batch):
+                # bisection COMPLETED some member on this same bucket:
+                # the bucket is demonstrably healthy (one request was
+                # poison), so the failure recorded above must not climb
+                # the consecutive-failure ladder toward CircuitOpen
+                br.record_success()
+                self._gauge_open_buckets()
             return
-        br.record_success()
-        self._gauge_open_buckets()
+        if br is not None:
+            br.record_success()
+            self._gauge_open_buckets()
         batch_span.set_attribute("outcome", "ok")
         batch_span.end()
         _monitor.observe_serving_cost(self._program, padded, batch_s,
@@ -908,6 +982,194 @@ class ServingEngine:
                 "wall time of one dispatched serving batch").observe(
                 batch_s)
         self._distribute(batch, outs, padded)
+
+    def _resolve_failed_batch(self, batch: List[_Request],
+                              cause: BaseException, depth: int,
+                              label: str,
+                              ctx: Optional[dict] = None) -> None:
+        """Blast-radius resolution for one failed batch: bisect when the
+        failure is state-safe and the depth budget allows (innocents
+        complete, the isolated culprit settles typed
+        :class:`PoisonRequest` and is quarantined), otherwise fail every
+        member typed :class:`BatchFailed`. Every member reaches exactly
+        one terminal outcome on every path; per-member deadlines stay
+        enforced (an expired member settles ``DeadlineExceeded`` instead
+        of riding a re-dispatch).
+
+        Poison candidates are DEFERRED into the depth-0 resolution
+        context and finalized only once the whole bisection completed:
+        the poison classification requires a completed batch-mate
+        witness (or a mate-less singleton batch) — when EVERY member of
+        a batch fails, the bucket is broken, not the requests, and
+        quarantining innocent feeds would shed legitimate resubmissions
+        at admission."""
+        top = ctx is None
+        if top:
+            ctx = {"poison": []}
+        live: List[_Request] = []
+        for r in batch:
+            if r.deadline is not None and r.deadline.expired:
+                self._settle_error(
+                    r, "deadline_exceeded",
+                    DeadlineExceeded(r.deadline.what, r.deadline.budget_s,
+                                     r.deadline.elapsed()),
+                    dispatched=True)
+            else:
+                live.append(r)
+        max_depth = self.config.bisect_depth
+        bisectable = max_depth > 0 and self._bisect_safe(cause)
+        if live and bisectable and len(live) == 1 and depth > 0:
+            # re-dispatched without batch mates and still failing: a
+            # culprit CANDIDATE — classified at the top of the recursion
+            ctx["poison"].append((live[0], cause))
+        elif live and bisectable and depth < max_depth:
+            # a singleton at depth 0 re-dispatches SOLO once (absorbing a
+            # transient and confirming a culprit); larger batches split
+            mid = max(1, (len(live) + 1) // 2)
+            halves = [live[:mid], live[mid:]]
+            if _monitor.enabled():
+                _monitor.counter(
+                    "serving_bisect_splits_total",
+                    "failed batches re-dispatched as bisected halves"
+                ).inc()
+            logger.warning(
+                "serving: bisecting failed batch of %d request(s) on "
+                "bucket %s (depth %d -> %d): %s: %s",
+                len(live), label, depth, depth + 1,
+                type(cause).__name__, cause)
+            for r in live:
+                # the old dispatch child closes here; the re-dispatch
+                # opens a fresh one under the same request root
+                if r.dispatch_span:
+                    r.dispatch_span.set_attribute("outcome", "bisect")
+                    r.dispatch_span.end()
+                    r.dispatch_span = _trace.NOOP_SPAN
+            for half in halves:
+                if half:
+                    self._run_batch(half, depth=depth + 1, ctx=ctx)
+        elif live:
+            self._fail_members(live, cause, label, depth)
+        if top and ctx["poison"]:
+            self._finalize_poison(batch, ctx["poison"], label)
+
+    def _finalize_poison(self, batch: List[_Request], candidates,
+                         label: str) -> None:
+        """Classify the deferred culprit candidates of one depth-0
+        resolution. A candidate is poison only with a completed-mate
+        WITNESS (some other member of the original batch succeeded once
+        the candidate was out) or when the original batch was a
+        mate-less singleton; with no witness, every member failed — a
+        broken bucket, settled :class:`BatchFailed` (and counted by the
+        breaker's consecutive-failure ladder), never a quarantined
+        innocent."""
+        witness = any(r.future.done() and r.future._error is None
+                      for r in batch)
+        if witness or len(batch) == 1:
+            for r, cause in candidates:
+                self._settle_poison(r, cause, label)
+            return
+        logger.warning(
+            "serving: refusing poison classification on bucket %s — all "
+            "%d member(s) failed (no completed-mate witness); the bucket "
+            "is broken, not one request", label, len(batch))
+        self._fail_members([r for r, _ in candidates],
+                           candidates[0][1], label, depth=0)
+
+    def _fail_members(self, live: List[_Request], cause: BaseException,
+                      label: str, depth: int) -> None:
+        for r in live:
+            # one instance per future: concurrent result() raises would
+            # otherwise interleave __traceback__ on a shared exception
+            err = BatchFailed(
+                f"serving: batch failed on bucket {label}: "
+                f"{type(cause).__name__}: {cause}")
+            err.__cause__ = cause
+            self._settle_error(r, "failed", err, dispatched=True)
+        if live:
+            # flight recorder: the incident ships with the failed
+            # requests' full span chains (settled above, so the terminal
+            # outcomes are already in the ring). Recorded at ANY depth —
+            # this is the terminal resolution of these requests, and a
+            # sub-batch that dies mid-bisection must not lose its dump
+            _trace.record_incident(
+                "batch_failed", error=cause, context=live[0].span,
+                detail=f"bucket {label}, {len(live)} request(s), "
+                       f"bisect depth {depth}")
+
+    def _settle_poison(self, r: _Request, cause: BaseException,
+                       label: str) -> None:
+        fp = r.fp or self._feed_fingerprint(r.feed)
+        err = PoisonRequest(
+            f"serving: request #{r.seq} isolated by bisection as the "
+            f"poison member of a failing batch on bucket {label} "
+            f"({type(cause).__name__}: {cause}); feed fingerprint {fp} "
+            f"quarantined", fingerprint=fp)
+        err.__cause__ = cause
+        with self._lock:
+            self._quarantine[fp] = self._quarantine.get(fp, 0)
+            self._quarantine.move_to_end(fp)
+            while len(self._quarantine) > max(1,
+                                              self.config.bisect_quarantine):
+                self._quarantine.popitem(last=False)
+            qsize = len(self._quarantine)
+        logger.warning("serving: POISON request #%d isolated on bucket "
+                       "%s — fingerprint %s quarantined (%s: %s)",
+                       r.seq, label, fp, type(cause).__name__, cause)
+        if _monitor.enabled():
+            _monitor.counter(
+                "serving_bisect_poison_total",
+                "poison requests isolated by batch bisection").inc()
+            _monitor.gauge(
+                "serving_bisect_quarantine_size",
+                "poison feed fingerprints currently quarantined").set(qsize)
+        self._settle_error(r, "poisoned", err, dispatched=True)
+        _trace.record_incident(
+            "poison_request", error=err, context=r.span,
+            detail=f"bucket {label}, fingerprint {fp}")
+
+    @staticmethod
+    def _bisect_safe(e: BaseException) -> bool:
+        """May a failed batch be re-dispatched in halves? NO when the
+        failure may have corrupted device state: a watchdog-broken hang
+        or a lost device leaves the executor in an unknown state, and an
+        error naming consumed/deleted donated buffers means re-running
+        would read through freed storage — those fail the WHOLE batch
+        (the pre-bisection contract). Walks the cause chain."""
+        try:
+            from ..resilience.distributed import WatchdogTimeout
+        except ImportError:                      # pragma: no cover
+            WatchdogTimeout = ()
+        try:
+            from ..resilience.elastic import DeviceLostError
+        except ImportError:                      # pragma: no cover
+            DeviceLostError = ()
+        seen = set()
+        cur: Optional[BaseException] = e
+        while cur is not None and id(cur) not in seen:
+            seen.add(id(cur))
+            if isinstance(cur, (WatchdogTimeout, DeviceLostError)):
+                return False
+            msg = str(cur).lower()
+            if "donated" in msg or "deleted" in msg:
+                return False
+            cur = cur.__cause__ or cur.__context__
+        return True
+
+    @staticmethod
+    def _feed_fingerprint(feed: Dict[str, np.ndarray]) -> str:
+        """Content hash of one request's feed — the quarantine key. Bit
+        sensitivity is deliberate: the SAME poison bytes are shed, a
+        perturbed resubmission gets a fresh chance."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for n in sorted(feed):
+            a = np.ascontiguousarray(feed[n])
+            h.update(n.encode("utf-8"))
+            h.update(str(a.dtype).encode("ascii"))
+            h.update(repr(a.shape).encode("ascii"))
+            h.update(a.tobytes())
+        return h.hexdigest()[:32]
 
     def _distribute(self, batch, outs, padded) -> None:
         now = time.monotonic()

@@ -30,11 +30,14 @@ from __future__ import annotations
 
 from .frontend import FrontendConfig, ServingFrontend
 from .router import FleetRouter, Replica, RouterConfig
+from .supervisor import (ReplicaCrashLoop, ReplicaSupervisor,
+                         SupervisedReplica, SupervisorConfig)
 from .wire import (SLO_CLASSES, TRACE_HEADER, WIRE_SCHEMA_VERSION,
                    ReplicaLost, WireError)
 
 __all__ = [
     "ServingFrontend", "FrontendConfig", "FleetRouter", "Replica",
-    "RouterConfig", "ReplicaLost", "WireError", "WIRE_SCHEMA_VERSION",
-    "TRACE_HEADER", "SLO_CLASSES",
+    "RouterConfig", "ReplicaSupervisor", "SupervisorConfig",
+    "SupervisedReplica", "ReplicaCrashLoop", "ReplicaLost", "WireError",
+    "WIRE_SCHEMA_VERSION", "TRACE_HEADER", "SLO_CLASSES",
 ]

@@ -47,6 +47,7 @@ from typing import Any, Dict, Optional
 
 from ... import monitor as _monitor
 from ... import trace as _trace
+from ...resilience import faults as _faults
 from ...resilience.deadline import DeadlineExceeded
 from ..engine import ServingError
 from ..generate import GenerativeEngine
@@ -210,8 +211,14 @@ class _Handler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0) or 0)
         return self.rfile.read(n) if n else b""
 
-    def _send_json(self, status: int, obj: dict) -> None:
+    def _send_json(self, status: int, obj: dict,
+                   corrupt: bool = False) -> None:
         raw = wire.dumps(obj)
+        if corrupt and raw:
+            # the wire_response 'corrupt' action: same length, mangled
+            # bytes — the router must classify this typed, never return
+            # a silent empty result
+            raw = b"\xff" + raw[1:]
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(raw)))
@@ -305,9 +312,24 @@ class _Handler(BaseHTTPRequestHandler):
     def _respond_best_effort(self, status: int, obj: dict) -> None:
         """Write a response to a caller that may already be gone; a dead
         connection is logged, never re-routed into the error path (the
-        engine-side outcome already holds)."""
+        engine-side outcome already holds). The ``wire_response`` fault
+        site fires HERE (request responses only — the health probes stay
+        clean, which is exactly what makes a stalling-but-listening
+        replica the breaker's hard case): ``drop`` severs the connection
+        before any byte, ``stall`` sleeps ``FLAGS_fault_stall_s`` first
+        (the router times out and must eject this replica), ``corrupt``
+        mangles the body bytes."""
         try:
-            self._send_json(status, obj)
+            act = _faults.fault_action("wire_response")
+            if act == "drop":
+                logger.warning("fleet frontend: injected wire_response "
+                               "drop — severing the connection")
+                self.close_connection = True
+                self.connection.close()
+                return
+            if act == "stall":
+                _faults.stall()
+            self._send_json(status, obj, corrupt=(act == "corrupt"))
         except (BrokenPipeError, ConnectionResetError, TimeoutError,
                 OSError):
             logger.debug("fleet frontend: client gone before the "
@@ -406,12 +428,27 @@ class _Handler(BaseHTTPRequestHandler):
             pass
 
     def _chunk(self, obj: Optional[dict]) -> None:
-        """One chunked-transfer frame (None = final empty chunk)."""
+        """One chunked-transfer frame (None = final empty chunk). The
+        ``wire_stream`` fault site fires per frame: ``drop`` severs the
+        stream mid-generation (the router delivers the partials, then a
+        typed terminal), ``stall`` delays the frame, ``corrupt`` mangles
+        it (the router classifies it typed instead of losing tokens)."""
         if obj is None:
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
             return
+        act = _faults.fault_action("wire_stream")
+        if act == "drop":
+            logger.warning("fleet frontend: injected wire_stream drop — "
+                           "severing the stream")
+            self.close_connection = True
+            self.connection.close()
+            raise BrokenPipeError("[resilience] injected wire_stream drop")
+        if act == "stall":
+            _faults.stall()
         line = wire.dumps(obj) + b"\n"
+        if act == "corrupt":
+            line = b"\xff" + line[1:]
         self.wfile.write(f"{len(line):x}\r\n".encode("ascii") + line
                          + b"\r\n")
         self.wfile.flush()

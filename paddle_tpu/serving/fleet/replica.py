@@ -85,6 +85,12 @@ def build_probe(name: str, config):
 
 
 def main(argv=None) -> int:
+    """Crash-safe entry: whatever kills the serve path, the ``exit``
+    JSON event still ships on stdout (reason + best-effort final
+    accounting) so the supervisor can CLASSIFY the failure from the
+    event stream instead of guessing from the exit code alone. Only a
+    real SIGKILL/`os._exit` (the ``kill`` fault action) leaves no event
+    — which is itself the supervisor's 'kill' classification."""
     t_start = time.perf_counter()
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model", default="mlp_tiny")
@@ -101,6 +107,17 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", action="store_true",
                     help="enable FLAGS_trace so request roots join the "
                          "router's trace ids")
+    ap.add_argument("--set-flag", action="append", default=[],
+                    metavar="FLAGS_name=value",
+                    help="set any framework flag in this replica "
+                         "(repeatable) — how the chaos gate arms "
+                         "per-replica fault plans, bisection and "
+                         "nan checks")
+    ap.add_argument("--crash-after-s", type=float, default=0.0,
+                    help="chaos hook: raise a RuntimeError this many "
+                         "seconds after ready (a REAL crash through the "
+                         "crash-path exit event) — the supervisor gate's "
+                         "deterministic crashing replica. 0 disables")
     ap.add_argument("--linger-s", type=float, default=2.0,
                     help="keep the front-end answering for this long "
                          "after the drain completes (clean 410 "
@@ -108,7 +125,28 @@ def main(argv=None) -> int:
                          "instead of connections dying in the accept "
                          "backlog at process exit)")
     args = ap.parse_args(argv)
+    state: dict = {}
+    try:
+        return _serve(args, t_start, state)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:
+        import traceback
 
+        traceback.print_exc()
+        info = {"event": "exit", "replica_id": args.replica_id,
+                "reason": "crash", "error": f"{type(e).__name__}: {e}"}
+        try:
+            eng = state.get("engine")
+            if eng is not None:
+                info["accounting"] = eng.accounting()
+        except Exception:
+            pass
+        print(json.dumps(info), flush=True)
+        return 21
+
+
+def _serve(args, t_start: float, state: dict) -> int:
     import paddle_tpu as fluid
     from paddle_tpu import aot_cache, serving
     from paddle_tpu.serving.fleet import ServingFrontend
@@ -118,6 +156,12 @@ def main(argv=None) -> int:
         flags["FLAGS_aot_cache_dir"] = args.aot_cache
     if args.trace:
         flags["FLAGS_trace"] = 1
+    for kv in args.set_flag:
+        if "=" not in kv:
+            raise SystemExit(f"--set-flag needs FLAGS_name=value, "
+                             f"got {kv!r}")
+        k, v = kv.split("=", 1)
+        flags[k] = v
     if flags:
         fluid.set_flags(flags)
 
@@ -125,6 +169,7 @@ def main(argv=None) -> int:
         max_batch=args.max_batch, queue_depth=args.queue_depth,
         queue_age_s=args.queue_age_s, batch_window_s=args.batch_window_s)
     eng, meta = build_probe(args.model, config)
+    state["engine"] = eng
 
     t0 = time.perf_counter()
     buckets = eng.warm_up()
@@ -153,9 +198,17 @@ def main(argv=None) -> int:
     # drain-stops the engine; stop() runs on the graceful callback
     # thread and returns only after the dispatch thread exits, so
     # "stopped and dispatch thread dead" == drain complete
+    crash_at = (time.monotonic() + args.crash_after_s
+                if args.crash_after_s > 0 else None)
     try:
         while True:
             time.sleep(0.1)
+            if crash_at is not None and time.monotonic() >= crash_at:
+                # the chaos hook: a genuine exception through the
+                # crash-path handler, exit event included
+                raise RuntimeError(
+                    f"injected replica crash (--crash-after-s "
+                    f"{args.crash_after_s:g})")
             if eng._stopped and (eng._thread is None
                                  or not eng._thread.is_alive()):
                 break
@@ -174,7 +227,8 @@ def main(argv=None) -> int:
     acct = eng.accounting()
     frontend.stop(wait_inflight_s=10.0)
     print(json.dumps({"event": "exit", "replica_id": args.replica_id,
-                      "accounting": acct}), flush=True)
+                      "reason": "drain", "accounting": acct}),
+          flush=True)
     return 0
 
 

@@ -30,6 +30,17 @@ fleet-wide:
 * **no hangs** — zero ready replicas is a typed
   :class:`~paddle_tpu.serving.Overloaded` (``reason="no_ready_replica"``)
   at submit, never a wait.
+* **per-replica transport breaker** — consecutive transport failures
+  (connect refused, connection died, request timeout, corrupt wire
+  payload) eject a replica from routing (``serving.breaker`` reused per
+  replica), so a stalling-but-listening replica stops eating
+  ``request_timeout_s`` per request. Half-open probes ride the
+  ``/healthz`` poll after the (doubling) cooldown; request traffic is
+  never the probe. A corrupt 200 body or stream chunk is a typed
+  :class:`~.wire.ReplicaLost`, never a silent empty/truncated result.
+* **dynamic membership** — ``add_replica``/``remove_replica``/
+  ``reassign_replica`` let the supervisor register a freshly (re)started
+  replica (same id, new port) as fresh capacity within one poll.
 * **trace propagation** — every dispatch carries the router's span
   context in ``X-PT-Trace``; the replica's request root joins it, so one
   trace id follows the request router -> frontend -> engine -> flight
@@ -55,7 +66,9 @@ import numpy as np
 
 from ... import monitor as _monitor
 from ... import trace as _trace
+from ...resilience import faults as _faults
 from ...resilience.deadline import DeadlineExceeded
+from ..breaker import CLOSED, CircuitBreaker
 from ..engine import Overloaded, ServingError
 from . import wire
 from .wire import ReplicaLost
@@ -80,6 +93,14 @@ class RouterConfig:
     # outweighs a handful of queued requests
     degraded_penalty: int = 16
     open_bucket_penalty: int = 8
+    # per-replica circuit breaker (serving.breaker reused): this many
+    # CONSECUTIVE transport failures (connect refused, connection died,
+    # request timeout, corrupt wire payload) eject the replica from
+    # routing — a stalling-but-listening replica must not eat
+    # request_timeout_s per request. Half-open probes ride the /healthz
+    # poll after the cooldown (doubling backoff per re-open).
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 1.0
 
 
 class Replica:
@@ -90,14 +111,27 @@ class Replica:
         self.host = host
         self.port = int(port)
         self._lock = threading.Lock()
-        self._snap: Dict[str, Any] = {"ok": False, "ready": False,
-                                      "queue_depth": 0, "degraded": False,
-                                      "open_buckets": 0, "generative": False,
-                                      "status": "unknown", "polled_at": 0.0}
+        # per-replica transport circuit breaker; attached/reset by the
+        # router (its config carries the thresholds)
+        self.breaker: Optional[CircuitBreaker] = None
+        self._snap: Dict[str, Any] = self._fresh_snap()
+
+    @staticmethod
+    def _fresh_snap() -> Dict[str, Any]:
+        return {"ok": False, "ready": False, "queue_depth": 0,
+                "degraded": False, "open_buckets": 0, "generative": False,
+                "status": "unknown", "polled_at": 0.0}
 
     @property
     def address(self) -> str:
-        return f"{self.host}:{self.port}"
+        host, port = self.endpoint()
+        return f"{host}:{port}"
+
+    def endpoint(self):
+        """Atomic ``(host, port)`` snapshot — dispatch/poll must never
+        observe a torn old-host/new-port pair across a ``reassign``."""
+        with self._lock:
+            return self.host, self.port
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -106,6 +140,15 @@ class Replica:
     def _update(self, snap: Dict[str, Any]) -> None:
         with self._lock:
             self._snap = snap
+
+    def reassign(self, host: str, port: int) -> None:
+        """Point this replica entry at a fresh process (same id, new
+        port) — the supervisor's restart path. The stale pressure
+        snapshot is dropped so the next poll decides readiness."""
+        with self._lock:
+            self.host = host
+            self.port = int(port)
+            self._snap = self._fresh_snap()
 
     def __repr__(self):
         return f"Replica({self.replica_id}@{self.address})"
@@ -122,20 +165,78 @@ class FleetRouter:
     them from your own worker threads for concurrency, exactly like
     ``ServingEngine.submit`` callers)."""
 
-    def __init__(self, replicas: Sequence,
+    def __init__(self, replicas: Sequence = (),
                  config: Optional[RouterConfig] = None):
-        self.replicas: List[Replica] = [
-            r if isinstance(r, Replica) else Replica(*r) for r in replicas]
-        if not self.replicas:
-            raise ValueError("fleet router needs at least one replica")
         self.config = config or RouterConfig()
+        # an EMPTY fleet is legal since the supervisor era (replicas
+        # register as they come ready); submits shed typed meanwhile
+        self.replicas: List[Replica] = []
         self._lock = threading.Lock()
+        self._breaker_lock = threading.Lock()
+        for r in replicas:
+            self.add_replica(r)
         self._rr = 0
         self._poll_thread: Optional[threading.Thread] = None
+        # in-flight /healthz poll connections: stop() closes them when a
+        # hung poll would otherwise outlive the join bound
+        self._poll_conns: set = set()
         self._stop_ev = threading.Event()
         self._acct: Dict[str, int] = {"submitted": 0, "retries": 0}
         self._acct.update({k: 0 for k in _TERMINAL_KEYS})
         self._pending = 0
+
+    # -- fleet membership (the supervisor's registration surface) --------
+    def _new_breaker(self, replica_id: str) -> CircuitBreaker:
+        # emit_transitions=False: the router owns its own
+        # router_breaker_transitions_total; the serving metric must keep
+        # meaning BUCKET breakers
+        return CircuitBreaker(self.config.breaker_threshold,
+                              self.config.breaker_cooldown_s,
+                              name=replica_id, emit_transitions=False)
+
+    def add_replica(self, replica) -> Replica:
+        """Register one replica (``Replica`` or ``(id, host, port)``).
+        Thread-safe; a duplicate id is a caller bug."""
+        r = replica if isinstance(replica, Replica) else Replica(*replica)
+        r.breaker = self._new_breaker(r.replica_id)
+        with self._lock:
+            if any(x.replica_id == r.replica_id for x in self.replicas):
+                raise ValueError(f"fleet router: replica id "
+                                 f"'{r.replica_id}' already registered")
+            # replace the list wholesale: _pick/poll iterate without the
+            # lock and must never see a half-mutated list
+            self.replicas = self.replicas + [r]
+        return r
+
+    def remove_replica(self, replica_id: str) -> Optional[Replica]:
+        """Deregister (a retired/drained replica). Returns the removed
+        entry, or ``None`` when unknown."""
+        with self._lock:
+            found = next((r for r in self.replicas
+                          if r.replica_id == replica_id), None)
+            if found is not None:
+                self.replicas = [r for r in self.replicas
+                                 if r is not found]
+        return found
+
+    def get_replica(self, replica_id: str) -> Optional[Replica]:
+        for r in self.replicas:
+            if r.replica_id == replica_id:
+                return r
+        return None
+
+    def reassign_replica(self, replica_id: str, host: str,
+                         port: int) -> Replica:
+        """A restarted replica (same id, NEW port) re-enters as fresh
+        capacity: snapshot dropped, transport breaker reset — the next
+        poll (the supervisor triggers one) makes it routable."""
+        r = self.get_replica(replica_id)
+        if r is None:
+            return self.add_replica(Replica(replica_id, host, port))
+        r.reassign(host, port)
+        with self._breaker_lock:
+            r.breaker = self._new_breaker(r.replica_id)
+        return r
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "FleetRouter":
@@ -153,7 +254,26 @@ class FleetRouter:
         self._stop_ev.set()
         t, self._poll_thread = self._poll_thread, None
         if t is not None:
-            t.join(5.0)
+            # bound teardown even when an in-flight /healthz poll is hung
+            # on a stalled replica: give it one connect budget, then
+            # close the socket under the read and join again
+            t.join(self.config.connect_timeout_s)
+            if t.is_alive():
+                with self._lock:
+                    conns = list(self._poll_conns)
+                logger.warning(
+                    "fleet router: poll thread still in a /healthz read "
+                    "at stop() — closing %d in-flight poll socket(s)",
+                    len(conns))
+                for c in conns:
+                    try:
+                        c.close()   # closes the underlying socket too
+                    except Exception:
+                        pass
+                t.join(2.0)
+                if t.is_alive():
+                    logger.error("fleet router: poll thread did not exit "
+                                 "after its socket was closed")
 
     def __enter__(self) -> "FleetRouter":
         return self.start()
@@ -168,11 +288,15 @@ class FleetRouter:
             self.poll_now()
 
     def poll_now(self) -> None:
-        """One synchronous poll of every replica's ``/healthz``."""
+        """One synchronous poll of every replica's ``/healthz``. A
+        healthy poll is also the transport breaker's HALF-OPEN probe: an
+        ejected replica whose cooldown elapsed and whose health answers
+        ready is readmitted here — no request traffic is risked on it."""
         ready = 0
         for r in self.replicas:
             snap = self._poll_one(r)
             r._update(snap)
+            self._breaker_probe(r, bool(snap["ok"] and snap["ready"]))
             ready += bool(snap["ok"] and snap["ready"])
             if _monitor.enabled():
                 _monitor.counter(
@@ -186,14 +310,19 @@ class FleetRouter:
                 "replicas currently ready for routing").set(ready)
 
     def _poll_one(self, r: Replica) -> Dict[str, Any]:
+        host, port = r.endpoint()
         try:
             conn = http.client.HTTPConnection(
-                r.host, r.port, timeout=self.config.connect_timeout_s)
+                host, port, timeout=self.config.connect_timeout_s)
+            with self._lock:
+                self._poll_conns.add(conn)
             try:
                 conn.request("GET", "/healthz")
                 resp = conn.getresponse()
                 raw = resp.read()
             finally:
+                with self._lock:
+                    self._poll_conns.discard(conn)
                 conn.close()
             # the /healthz body is the engine's FROZEN health schema —
             # its schema_version field is HEALTH_SCHEMA_VERSION, NOT the
@@ -221,6 +350,80 @@ class FleetRouter:
                     "status": f"unreachable:{type(e).__name__}",
                     "polled_at": time.monotonic()}
 
+    # -- per-replica transport breaker -----------------------------------
+    # serving.breaker.CircuitBreaker documents single-thread allow/record;
+    # router dispatches run from arbitrary caller threads plus the poll
+    # thread, so every breaker touch goes through _breaker_lock.
+
+    def _breaker_note(self, r: Replica, before: str) -> None:
+        after = r.breaker.state
+        if after == before:
+            return
+        logger.warning("fleet router: replica %s transport breaker %s -> "
+                       "%s", r.replica_id, before, after)
+        if _monitor.enabled():
+            _monitor.counter(
+                "router_breaker_transitions_total",
+                "per-replica transport breaker state changes").labels(
+                replica=r.replica_id, to=after).inc()
+            _monitor.gauge(
+                "router_breaker_open_replicas",
+                "replicas currently ejected by their transport breaker"
+            ).set(sum(1 for x in self.replicas
+                      if x.breaker is not None
+                      and x.breaker.state != CLOSED))
+
+    def _breaker_failure(self, r: Replica,
+                         br: Optional[CircuitBreaker] = None) -> None:
+        """``br`` (captured when the dispatch STARTED) pins the record
+        to one incarnation: a straggler failing against a replica that
+        was reassigned mid-flight must not eject the fresh restart."""
+        with self._breaker_lock:
+            if br is not None and r.breaker is not br:
+                return
+            before = r.breaker.state
+            r.breaker.record_failure()
+            self._breaker_note(r, before)
+
+    def _breaker_success(self, r: Replica,
+                         br: Optional[CircuitBreaker] = None) -> None:
+        with self._breaker_lock:
+            if br is not None and r.breaker is not br:
+                return
+            before = r.breaker.state
+            r.breaker.record_success()
+            self._breaker_note(r, before)
+
+    def _breaker_admits(self, r: Replica) -> bool:
+        """Routing admission: only a CLOSED breaker routes. Open and
+        half-open replicas wait for the /healthz poll probe — request
+        traffic is never the probe."""
+        with self._breaker_lock:
+            return r.breaker is None or r.breaker.state == CLOSED
+
+    def _breaker_probe(self, r: Replica, healthy: bool) -> None:
+        """The half-open probe riding one /healthz poll result: after
+        the cooldown a healthy poll closes the breaker (fresh capacity),
+        an unhealthy one re-opens it onto the next backoff rung."""
+        if r.breaker is None or r.breaker.state == CLOSED:
+            return
+        with self._breaker_lock:
+            before = r.breaker.state
+            verdict = r.breaker.allow()
+            # both legs are noted separately: a failed probe's
+            # open -> half_open -> open pair would otherwise compare
+            # equal and leave re-opens (and the doubling cooldown
+            # ladder) invisible in logs and the transition counter
+            self._breaker_note(r, before)
+            if verdict != "probe":
+                return
+            before = r.breaker.state
+            if healthy:
+                r.breaker.record_success()
+            else:
+                r.breaker.record_failure()
+            self._breaker_note(r, before)
+
     # -- routing policy --------------------------------------------------
     def _score(self, snap: Dict[str, Any]) -> int:
         return (int(snap["queue_depth"])
@@ -238,7 +441,7 @@ class FleetRouter:
         # poll (a concurrent poll-thread update between reads could pass
         # a replica no single poll considered routable)
         cands = [(r, r.snapshot()) for r in self.replicas
-                 if r not in exclude]
+                 if r not in exclude and self._breaker_admits(r)]
         if require_generative:
             cands = [(r, s) for r, s in cands if s.get("generative")]
         if self.config.honor_drain:
@@ -336,7 +539,16 @@ class FleetRouter:
             status, resp_body, replica = self._dispatch(
                 "/v1/submit", body, span)
             if status == 200:
-                outs = wire.decode_outputs(resp_body)
+                try:
+                    outs = wire.decode_outputs(resp_body)
+                except wire.WireError as we:
+                    # parseable JSON whose arrays don't decode is the
+                    # same wire-integrity class as an unparseable body
+                    raise ReplicaLost(
+                        f"fleet: replica {replica} answered 200 with "
+                        f"undecodable output arrays (wire corruption; "
+                        f"request may have been admitted — not retried): "
+                        f"{we}", replica=replica) from we
                 span.set_attribute("outcome", "completed")
                 span.set_attribute("replica", replica)
                 span.end()
@@ -416,6 +628,17 @@ class FleetRouter:
                 raise ReplicaLost(
                     f"fleet: replica {r.replica_id} unreachable and "
                     f"retry exhausted: {exc}", replica=r.replica_id)
+            if kind == "corrupt":
+                # an undecodable body on a 200 (the request may have
+                # been admitted AND completed replica-side) or on a
+                # status whose authoritative admitted flag is unreadable:
+                # never retried, never a silent empty result
+                _, exc, status = outcome
+                raise ReplicaLost(
+                    f"fleet: replica {r.replica_id} answered {status} "
+                    f"with an undecodable body (wire corruption; request "
+                    f"may have been admitted — not retried): {exc}",
+                    replica=r.replica_id)
             # kind == "lost": possibly admitted — never retried
             _, exc = outcome
             raise ReplicaLost(
@@ -446,47 +669,94 @@ class FleetRouter:
         caller owns and closes ``conn``), else the transport
         classification of :meth:`_route_with_retry`:
         ``("unadmitted", exc)`` — provably never received it;
-        ``("lost", exc)``       — sent, then the connection died."""
+        ``("lost", exc)``       — sent, then the connection died.
+        Both transport failures feed the replica's circuit breaker.
+
+        Chaos: the ``wire_connect`` fault site fires HERE, before any
+        request bytes move — ``drop`` severs the dial (unadmitted, so
+        the sibling retry must absorb it), ``stall`` delays the dial,
+        ``corrupt`` mangles the request payload (the replica answers a
+        400 the retry policy classifies unadmitted)."""
+        payload = wire.dumps(body)
+        br0 = r.breaker          # this dispatch's incarnation
+        host, port = r.endpoint()    # atomic across a reassign
         conn = http.client.HTTPConnection(
-            r.host, r.port, timeout=self.config.request_timeout_s)
+            host, port, timeout=self.config.request_timeout_s)
         try:
+            act = _faults.fault_action("wire_connect")
+            if act == "drop":
+                raise ConnectionRefusedError(
+                    "[resilience] injected wire_connect drop")
+            if act == "stall":
+                _faults.stall()
+            elif act == "corrupt":
+                payload = b"\xff\x00corrupt" + payload[9:]
             # explicit connect with its own (short) timeout so a dead
             # replica is classified BEFORE any request bytes move
             conn.sock = socket.create_connection(
-                (r.host, r.port), timeout=self.config.connect_timeout_s)
+                (host, port), timeout=self.config.connect_timeout_s)
             conn.sock.settimeout(self.config.request_timeout_s)
         except OSError as e:
             conn.close()
+            self._breaker_failure(r, br0)
             return ("unadmitted", e)
         headers = {"Content-Type": "application/json"}
         if span and span.trace_id:
             headers[wire.TRACE_HEADER] = span.context.to_wire()
         try:
-            conn.request("POST", path, body=wire.dumps(body),
-                         headers=headers)
+            conn.request("POST", path, body=payload, headers=headers)
             resp = conn.getresponse()
         except (OSError, http.client.HTTPException) as e:
             conn.close()
+            self._breaker_failure(r, br0)
             return ("lost", e)
         return ("conn", conn, resp)
 
     def _post_once(self, r: Replica, path: str, body: dict, span):
         """One POST attempt, read to the end of the body, classified:
-        ``("response", status, body)`` — the replica answered; else the
-        transport classifications of :meth:`_connect_and_post`."""
+        ``("response", status, body)`` — the replica answered;
+        ``("corrupt", exc)`` — the replica answered 200 with an
+        undecodable body (a wire-integrity failure: the request may have
+        been admitted AND completed replica-side, so it is surfaced as a
+        typed :class:`ReplicaLost`, never a silent empty result); else
+        the transport classifications of :meth:`_connect_and_post`.
+        The ``wire_response`` fault site fires around the body read."""
+        br0 = r.breaker          # this dispatch's incarnation
         out = self._connect_and_post(r, path, body, span)
         if out[0] != "conn":
             return out
         _, conn, resp = out
         try:
+            act = _faults.fault_action("wire_response")
+            if act == "drop":
+                self._breaker_failure(r, br0)
+                return ("lost", ConnectionResetError(
+                    "[resilience] injected wire_response drop"))
+            if act == "stall":
+                _faults.stall()
             try:
                 raw = resp.read()
             except (OSError, http.client.HTTPException) as e:
+                self._breaker_failure(r, br0)
                 return ("lost", e)
+            if act == "corrupt" and raw:
+                raw = b"\xff" + raw[1:]
             try:
                 parsed = wire.loads(raw) if raw else {}
-            except wire.WireError:
-                parsed = {}
+            except wire.WireError as we:
+                # an undecodable body is a wire-integrity failure. For a
+                # 200, or for a status the retry policy would redispatch
+                # (the body's AUTHORITATIVE admitted flag is unreadable —
+                # an admitted EngineStopped travels as 410 too), guessing
+                # could give one request two outcomes: typed ReplicaLost,
+                # never retried. Other statuses are final either way and
+                # degrade to the status map.
+                self._breaker_failure(r, br0)
+                if resp.status == 200 \
+                        or resp.status in wire.UNADMITTED_STATUSES:
+                    return ("corrupt", we, resp.status)
+                return ("response", resp.status, {})
+            self._breaker_success(r, br0)
             return ("response", resp.status, parsed)
         finally:
             conn.close()
@@ -533,6 +803,7 @@ class FleetRouter:
         as submit, stopping at response HEADERS (the body streams).
         Routed only to replicas advertising the generative capability."""
         def attempt(r: Replica):
+            br0 = r.breaker      # this dispatch's incarnation
             out = self._connect_and_post(r, "/v1/generate", body, span)
             if out[0] != "conn":
                 return out
@@ -546,7 +817,13 @@ class FleetRouter:
             conn.close()
             try:
                 parsed = wire.loads(raw) if raw else {}
-            except wire.WireError:
+            except wire.WireError as we:
+                # same wire-integrity rule as _post_once: a corrupt body
+                # on a status the retry policy would redispatch hides
+                # the authoritative admitted flag — never guess
+                if resp.status in wire.UNADMITTED_STATUSES:
+                    self._breaker_failure(r, br0)
+                    return ("corrupt", we, resp.status)
                 parsed = {}
 
             def raise_typed(parsed=parsed, status=resp.status):
@@ -562,20 +839,41 @@ class FleetRouter:
     def _stream_tokens(self, conn, resp, replica: Replica,
                        span, t0: float) -> Iterator[int]:
         streamed = 0
+        br0 = replica.breaker    # this stream's incarnation
         outcome_err: Optional[BaseException] = None
         done = False
         try:
             while True:
+                # the wire_stream fault site fires once per chunk read:
+                # drop severs the stream, stall delays it, corrupt
+                # mangles the chunk (hardened below into a typed loss)
+                act = _faults.fault_action("wire_stream")
+                if act == "drop":
+                    self._breaker_failure(replica, br0)
+                    outcome_err = ReplicaLost(
+                        f"fleet: replica {replica.replica_id} stream "
+                        f"dropped (injected) after {streamed} token(s)",
+                        replica=replica.replica_id)
+                    break
+                if act == "stall":
+                    _faults.stall()
                 try:
                     line = resp.readline()
                 except (OSError, http.client.HTTPException) as e:
+                    self._breaker_failure(replica, br0)
                     outcome_err = ReplicaLost(
                         f"fleet: replica {replica.replica_id} died "
                         f"mid-stream after {streamed} token(s): {e}",
                         replica=replica.replica_id)
                     break
+                if act == "corrupt":
+                    # BEFORE the EOF check: a fired corrupt action must
+                    # perform even when it lands on the terminating read
+                    # (fired == performed, the audit-trail contract)
+                    line = b"\xff" + line[1:]
                 if not line:
                     if not done:
+                        self._breaker_failure(replica, br0)
                         outcome_err = ReplicaLost(
                             f"fleet: replica {replica.replica_id} closed "
                             f"the stream without a terminal chunk "
@@ -584,8 +882,17 @@ class FleetRouter:
                     break
                 try:
                     obj = wire.loads(line)
-                except wire.WireError:
-                    continue
+                except wire.WireError as we:
+                    # an unparseable chunk is wire corruption, not noise:
+                    # skipping it would silently lose tokens. Partials
+                    # already yielded stand; the stream dies typed.
+                    self._breaker_failure(replica, br0)
+                    outcome_err = ReplicaLost(
+                        f"fleet: replica {replica.replica_id} sent a "
+                        f"corrupt stream chunk after {streamed} "
+                        f"token(s) (not retried): {we}",
+                        replica=replica.replica_id)
+                    break
                 if obj.get("done"):
                     done = True
                     if obj.get("error"):
@@ -608,6 +915,7 @@ class FleetRouter:
                                    replica.replica_id)
                 span.end(error=outcome_err)
             else:
+                self._breaker_success(replica, br0)
                 span.set_attribute("outcome", "completed")
                 span.set_attribute("replica", replica.replica_id)
                 span.end()

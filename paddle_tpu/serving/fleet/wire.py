@@ -30,7 +30,7 @@ import numpy as np
 
 from ...resilience.deadline import DeadlineExceeded
 from ..engine import (BatchFailed, CircuitOpen, EngineStopped, Overloaded,
-                      ServingError)
+                      PoisonRequest, ServingError)
 
 __all__ = [
     "WIRE_SCHEMA_VERSION", "TRACE_HEADER", "SLO_CLASSES",
@@ -190,6 +190,8 @@ def error_body(exc: BaseException,
     if isinstance(exc, DeadlineExceeded):
         err.update(what=exc.what, budget_s=exc.budget_s,
                    elapsed_s=exc.elapsed_s)
+    if isinstance(exc, PoisonRequest):
+        err["fingerprint"] = exc.fingerprint
     if isinstance(exc, ReplicaLost):
         err["replica"] = exc.replica
     return {"schema_version": WIRE_SCHEMA_VERSION, "error": err}
@@ -214,6 +216,10 @@ def error_from_body(body: Optional[dict],
         e = DeadlineExceeded(err.get("what", msg),
                              float(err.get("budget_s", 0.0)),
                              float(err.get("elapsed_s", 0.0)))
+    elif typ == "PoisonRequest":
+        # still travels as 500 (a BatchFailed subclass), but the caller
+        # can tell "you poisoned the batch" from "the bucket is broken"
+        e = PoisonRequest(msg, fingerprint=err.get("fingerprint", ""))
     elif typ == "BatchFailed":
         e = BatchFailed(msg)
     elif typ == "ReplicaLost":

@@ -1,0 +1,812 @@
+"""Fleet self-healing tests (ISSUE 15): wire-site fault injection,
+poison-request bisection, router transport breaker + hardening, and the
+replica supervisor (stub-process based — the real-replica end-to-end
+story is ``tools/load_check.py --fleet-chaos``)."""
+from __future__ import annotations
+
+import json
+import socket
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import serving
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving.fleet import (FleetRouter, Replica, ReplicaCrashLoop,
+                                      ReplicaLost, ReplicaSupervisor,
+                                      RouterConfig, ServingFrontend,
+                                      SupervisorConfig, wire)
+
+
+@pytest.fixture(autouse=True)
+def _flags_reset():
+    from paddle_tpu import flags as flags_mod
+
+    snap = dict(flags_mod._overrides)
+    yield
+    flags_mod._overrides.clear()
+    flags_mod._overrides.update(snap)
+    flags_mod._set_epoch += 1
+
+
+# ---------------------------------------------------------------------------
+# faults: wire sites, data-plane actions, seeded determinism, audit trail
+# ---------------------------------------------------------------------------
+
+def test_wire_sites_registered_and_data_actions_validated():
+    assert {"wire_connect", "wire_response", "wire_stream"} \
+        <= set(faults.SITES)
+    # data-plane actions parse at wire sites only
+    faults.FaultPlan("wire_connect:1:drop,wire_response:@2:corrupt,"
+                     "wire_stream:p0.5:stall")
+    with pytest.raises(ValueError, match="data-plane wire action"):
+        faults.FaultPlan("step:1:drop")
+    with pytest.raises(ValueError, match="unknown action"):
+        faults.FaultPlan("wire_connect:1:mangle")
+
+
+def test_wire_probability_rules_seeded_deterministic():
+    """Same plan + seed => the same fire pattern, run after run — the
+    documented pX replay contract at the new sites."""
+    def pattern(seed):
+        p = faults.FaultPlan("wire_response:p0.4:drop", seed=seed)
+        return [p.action("wire_response") for _ in range(32)]
+
+    a, b = pattern(11), pattern(11)
+    assert a == b
+    assert 0 < sum(x is not None for x in a) < 32   # actually probabilistic
+    assert pattern(12) != a                          # seed-sensitive
+
+
+def test_wire_fired_audit_trail_records_hits():
+    p = faults.FaultPlan("wire_connect:@2:drop,wire_stream:1:corrupt")
+    assert p.action("wire_stream") == "corrupt"
+    assert p.action("wire_connect") is None
+    assert p.action("wire_connect") == "drop"
+    assert ("wire_stream", 1, "corrupt") in p.fired
+    assert ("wire_connect", 2, "drop") in p.fired
+    assert len(p.fired) == 2
+
+
+def test_fault_action_still_raises_exception_actions():
+    with faults.fault_plan_guard("wire_connect:1:ConnectionError"):
+        with pytest.raises(ConnectionError) as ei:
+            faults.fault_action("wire_connect")
+        assert isinstance(ei.value, faults.InjectedFault)
+    # and fault_point at a wire site ignores (logs) a data action rather
+    # than crashing — defense for a plan/probe mismatch
+    with faults.fault_plan_guard("wire_connect:1:drop"):
+        faults.fault_point("wire_connect")
+
+
+def test_stall_duration_flag():
+    fluid.set_flags({"FLAGS_fault_stall_s": 0.08})
+    t0 = time.monotonic()
+    faults.stall()
+    assert 0.06 <= time.monotonic() - t0 < 1.0
+
+
+# ---------------------------------------------------------------------------
+# engine: poison-request bisection + quarantine
+# ---------------------------------------------------------------------------
+
+def _build_infer(hidden=4, in_dim=13):
+    import paddle_tpu.unique_name as un
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[in_dim], dtype="float32")
+            pred = fluid.layers.fc(x, hidden, act="softmax")
+        infer = main.clone(for_test=True)
+    return infer, startup, pred.name
+
+
+def _engine(**cfg_kw):
+    infer, startup, pred = _build_infer()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    cfg = serving.ServingConfig(max_batch=cfg_kw.pop("max_batch", 4),
+                                **cfg_kw)
+    return serving.ServingEngine(infer, feed_names=["x"],
+                                 fetch_list=[pred], scope=scope,
+                                 executor=exe, config=cfg)
+
+
+def _feed(rows=1, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.rand(rows, 13).astype(np.float32)}
+
+
+def _poison():
+    f = _feed(seed=999)
+    f["x"][0, :5] = np.nan
+    return f
+
+
+def _bisect_engine(**kw):
+    fluid.set_flags({"FLAGS_check_nan_inf": 1})
+    kw.setdefault("bisect_depth", 3)
+    kw.setdefault("batch_window_s", 0.2)
+    eng = _engine(**kw)
+    eng.warm_up()
+    eng.start()
+    return eng
+
+
+def test_poison_isolated_innocents_bit_exact():
+    """[i1, i2, i3, poison] coalesce into one batch; bisection splits
+    [i1,i2] | [i3,p] and then [i3] | [p]. Bit-exactness is asserted
+    against clean baselines AT THE SAME BUCKETS bisection re-dispatches
+    at (i1+i2 co-batched at bucket 2, i3 solo at bucket 1) — XLA results
+    legitimately differ in ULPs across bucket sizes, so a same-bucket
+    baseline is the meaningful 'correct results' claim."""
+    eng = _bisect_engine()
+    try:
+        i1, i2, i3 = (_feed(seed=i) for i in range(3))
+        b1, b2 = eng.submit(i1), eng.submit(i2)      # clean pair, bucket 2
+        base1, base2 = b1.result(timeout=60), b2.result(timeout=60)
+        base3 = eng.submit(i3).result(timeout=60)    # clean solo, bucket 1
+        f1, f2, f3 = eng.submit(i1), eng.submit(i2), eng.submit(i3)
+        pfut = eng.submit(_poison())
+        perr = pfut.exception(timeout=60)
+        assert isinstance(perr, serving.PoisonRequest)
+        assert perr.fingerprint
+        assert isinstance(perr.__cause__, FloatingPointError)
+        assert np.array_equal(f1.result(timeout=60)[0], base1[0])
+        assert np.array_equal(f2.result(timeout=60)[0], base2[0])
+        assert np.array_equal(f3.result(timeout=60)[0], base3[0])
+        acct = eng.accounting()
+        assert acct["exact"] and acct["poisoned"] == 1
+        assert acct["failed"] == 0      # no whole-batch failure leaked
+    finally:
+        eng.stop()
+
+
+def test_quarantine_sheds_repeat_offender_typed():
+    eng = _bisect_engine()
+    try:
+        poison = _poison()
+        err = eng.submit(poison).exception(timeout=60)
+        assert isinstance(err, serving.PoisonRequest)
+        with pytest.raises(serving.Overloaded) as ei:
+            eng.submit(poison)
+        assert ei.value.reason == "poison_quarantine"
+        # a DIFFERENT feed is untouched by the quarantine
+        assert eng.submit(_feed(seed=5)).result(timeout=60)
+        acct = eng.accounting()
+        assert acct["exact"] and acct["shed"] == 1
+    finally:
+        eng.stop()
+
+
+def test_quarantine_is_bounded():
+    eng = _bisect_engine(bisect_quarantine=2)
+    try:
+        for s in (101, 102, 103):
+            f = _feed(seed=s)
+            f["x"][0, 0] = np.nan
+            err = eng.submit(f).exception(timeout=60)
+            assert isinstance(err, serving.PoisonRequest)
+        assert len(eng._quarantine) == 2    # oldest evicted
+    finally:
+        eng.stop()
+
+
+def test_transient_batch_fault_absorbed_by_bisection():
+    """An injected depth-0 batch failure whose re-dispatch succeeds:
+    EVERY member completes — bisection turns a transient whole-batch
+    failure into zero caller-visible errors."""
+    from paddle_tpu.resilience import fault_plan_guard
+
+    eng = _bisect_engine()
+    try:
+        with fault_plan_guard("batch_dispatch:@1:RuntimeError"):
+            futs = [eng.submit(_feed(seed=i)) for i in range(4)]
+            res = [f.result(timeout=60) for f in futs]
+        assert len(res) == 4
+        acct = eng.accounting()
+        assert acct["exact"] and acct["failed"] == 0 \
+            and acct["poisoned"] == 0 and acct["completed"] == 4
+    finally:
+        eng.stop()
+
+
+def test_bisected_poisons_do_not_open_the_bucket_breaker():
+    """Distinct poison feeds arriving round after round on one bucket:
+    each bisection proves the bucket healthy (the co-batched innocent
+    completes), so the depth-0 breaker failure is compensated and the
+    bucket never reaches CircuitOpen against innocents."""
+    eng = _bisect_engine(breaker_threshold=2)
+    try:
+        for j in range(4):   # 2x the threshold
+            poison = _feed(seed=300 + j)
+            poison["x"][0, 0] = np.nan
+            innocent = _feed(seed=400 + j)
+            pf = eng.submit(poison)
+            inf_ = eng.submit(innocent)
+            assert isinstance(pf.exception(timeout=60),
+                              serving.PoisonRequest)
+            assert inf_.result(timeout=60)
+        assert all(b.state == "closed" for b in eng._breakers.values())
+        acct = eng.accounting()
+        assert acct["exact"] and acct["circuit_open"] == 0
+    finally:
+        eng.stop()
+
+
+def test_broken_bucket_never_quarantines_innocents():
+    """When EVERY member of a batch fails (a broken bucket, not one bad
+    request) there is no completed-mate witness: members settle
+    BatchFailed — never PoisonRequest — and nothing is quarantined, so
+    legitimate resubmissions are not shed at admission."""
+    eng = _bisect_engine()
+    try:
+        def broken(*a, **k):
+            raise RuntimeError("bucket broken (state-safe)")
+
+        real_run = eng._exe.run
+        eng._exe.run = broken
+        futs = [eng.submit(_feed(seed=i)) for i in range(2)]
+        errs = [f.exception(timeout=60) for f in futs]
+        assert all(isinstance(e, serving.BatchFailed) for e in errs)
+        assert not any(isinstance(e, serving.PoisonRequest) for e in errs)
+        assert len(eng._quarantine) == 0
+        # the bucket heals -> the same feeds complete (not shed)
+        eng._exe.run = real_run
+        for i in range(2):
+            assert eng.submit(_feed(seed=i)).result(timeout=60)
+        acct = eng.accounting()
+        assert acct["exact"] and acct["poisoned"] == 0 \
+            and acct["shed"] == 0 and acct["failed"] == 2
+    finally:
+        eng.stop()
+
+
+def test_bisect_off_keeps_whole_batch_failure():
+    """Default config (bisect_depth=0): the PR 8 semantics stand — a
+    failed batch fails every member typed BatchFailed."""
+    from paddle_tpu.resilience import fault_plan_guard
+
+    fluid.set_flags({"FLAGS_check_nan_inf": 1})
+    eng = _engine(batch_window_s=0.2)
+    eng.warm_up()
+    eng.start()
+    try:
+        futs = [eng.submit(_feed(seed=i)) for i in range(2)]
+        pfut = eng.submit(_poison())
+        errs = [f.exception(timeout=60) for f in futs + [pfut]]
+        assert all(isinstance(e, serving.BatchFailed) for e in errs)
+        assert not any(isinstance(e, serving.PoisonRequest) for e in errs)
+        acct = eng.accounting()
+        assert acct["exact"] and acct["failed"] == 3
+    finally:
+        eng.stop()
+
+
+def test_bisect_safety_classification():
+    """Device-state-corrupting failures must never bisect: the whole
+    batch fails rather than re-dispatching on corrupted state."""
+    from paddle_tpu.resilience.distributed import WatchdogTimeout
+    from paddle_tpu.resilience.elastic import DeviceLostError
+
+    safe = serving.ServingEngine._bisect_safe
+    assert safe(FloatingPointError("Nan found in output"))
+    assert safe(RuntimeError("injected transient"))
+    assert not safe(WatchdogTimeout("step", 2.0))
+    assert not safe(DeviceLostError("chip preempted"))
+    assert not safe(RuntimeError("Array has been deleted or donated"))
+    # the classification walks the cause chain
+    wrapped = RuntimeError("batch failed")
+    wrapped.__cause__ = WatchdogTimeout("step", 2.0)
+    assert not safe(wrapped)
+
+
+def test_unsafe_error_fails_whole_batch_despite_bisection(monkeypatch):
+    from paddle_tpu.resilience import fault_plan_guard
+
+    eng = _bisect_engine()
+    monkeypatch.setattr(serving.ServingEngine, "_bisect_safe",
+                        staticmethod(lambda e: False))
+    try:
+        with fault_plan_guard("batch_dispatch:@1:RuntimeError"):
+            futs = [eng.submit(_feed(seed=i)) for i in range(3)]
+            errs = [f.exception(timeout=60) for f in futs]
+        assert all(isinstance(e, serving.BatchFailed) for e in errs)
+        assert eng.accounting()["failed"] == 3
+    finally:
+        eng.stop()
+
+
+def test_expired_member_settles_deadline_not_redispatch(monkeypatch):
+    """A member whose deadline expired by resolution time gets its typed
+    DeadlineExceeded instead of riding a bisected re-dispatch."""
+    eng = _bisect_engine()
+    try:
+        real_resolve = serving.ServingEngine._resolve_failed_batch
+
+        def slow_resolve(self, batch, cause, depth, label, ctx=None):
+            if depth == 0:
+                time.sleep(0.3)   # outlive the poison batch's deadlines
+            return real_resolve(self, batch, cause, depth, label, ctx)
+
+        monkeypatch.setattr(serving.ServingEngine, "_resolve_failed_batch",
+                            slow_resolve)
+        futs = [eng.submit(_feed(seed=i), deadline_s=0.25)
+                for i in range(2)]
+        pfut = eng.submit(_poison(), deadline_s=0.25)
+        errs = [f.exception(timeout=60) for f in futs + [pfut]]
+        assert all(isinstance(e, serving.DeadlineExceeded) for e in errs)
+        acct = eng.accounting()
+        assert acct["exact"] and acct["deadline_exceeded"] == 3
+    finally:
+        eng.stop()
+
+
+def test_poison_request_wire_roundtrip():
+    e = serving.PoisonRequest("bad feed", fingerprint="abcd1234")
+    assert wire.status_for(e) == 500          # a BatchFailed subclass
+    body = wire.error_body(e, admitted=True)
+    assert body["error"]["fingerprint"] == "abcd1234"
+    back = wire.error_from_body(body)
+    assert isinstance(back, serving.PoisonRequest)
+    assert back.fingerprint == "abcd1234"
+    assert not wire.response_is_unadmitted(500, body)   # never retried
+
+
+# ---------------------------------------------------------------------------
+# router: transport breaker, corrupt hardening, bounded stop
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fleet1():
+    """One real in-process replica behind a router configured with a
+    tight transport breaker; tests add canned/dead siblings."""
+    eng = _engine(batch_window_s=0.005)
+    eng.warm_up()
+    eng.start()
+    fe = ServingFrontend(eng, replica_id="good")
+    fe.start()
+    router = FleetRouter(
+        [Replica("good", "127.0.0.1", fe.port)],
+        RouterConfig(poll_interval_s=0.05, connect_timeout_s=2.0,
+                     request_timeout_s=5.0, breaker_threshold=2,
+                     breaker_cooldown_s=0.2))
+    router.poll_now()
+    yield router, eng, fe
+    router.stop()
+    fe.stop(wait_inflight_s=2.0)
+    if not eng._stopped:
+        eng.stop(drain=False)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_router_breaker_ejects_dead_replica_after_threshold(fleet1):
+    router, _, _ = fleet1
+    dead = router.add_replica(Replica("dead", "127.0.0.1", _free_port()))
+    # force the dead replica to look routable so dispatch tries it
+    router.config.honor_drain = False
+    for i in range(8):
+        assert router.submit(_feed(seed=i))[0].shape == (1, 4)
+    # consecutive connect-refusals opened the breaker; once open, the
+    # dead replica is excluded — retries stop growing
+    assert dead.breaker.state == "open"
+    retries_at_open = router.accounting()["retries"]
+    for i in range(4):
+        router.submit(_feed(seed=10 + i))
+    assert router.accounting()["retries"] == retries_at_open
+    acct = router.accounting()
+    assert acct["exact"] and acct["completed"] == 12
+
+
+def test_router_breaker_probe_rides_healthz_poll(fleet1):
+    router, _, _ = fleet1
+    good = router.get_replica("good")
+    # trip the breaker by hand (threshold 2), then let polls probe it
+    router._breaker_failure(good)
+    router._breaker_failure(good)
+    assert good.breaker.state == "open"
+    assert router._pick() is None          # ejected from routing
+    deadline = time.monotonic() + 5.0
+    while good.breaker.state != "closed" and time.monotonic() < deadline:
+        router.poll_now()
+        time.sleep(0.05)
+    assert good.breaker.state == "closed"  # healthz probe readmitted it
+    assert router._pick() is good
+    assert router.submit(_feed())[0].shape == (1, 4)
+
+
+def test_router_corrupt_200_is_typed_replica_lost(fleet1):
+    from paddle_tpu.resilience import fault_plan_guard
+
+    router, _, _ = fleet1
+    with fault_plan_guard("wire_response:@1:corrupt"):
+        with pytest.raises(ReplicaLost, match="undecodable"):
+            router.submit(_feed())
+    # breaker counted the corruption; the next clean submit works
+    assert router.submit(_feed())[0].shape == (1, 4)
+    acct = router.accounting()
+    assert acct["exact"] and acct["replica_lost"] == 1
+
+
+def test_router_corrupt_retryable_status_never_redispatches():
+    """A corrupt body on a status the retry policy WOULD redispatch
+    (410/429) loses the authoritative `admitted` flag — an admitted
+    EngineStopped travels as 410 too, so guessing from the status map
+    could execute one request twice. Must be typed ReplicaLost, and the
+    sibling must receive nothing."""
+
+    class _H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            raw = wire.dumps({"schema_version": 1, "status": "ok",
+                              "ready": True, "queue_depth": 0,
+                              "degraded": False, "open_buckets": []})
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            self.rfile.read(n)
+            raw = b"\xffgarbage-not-json"
+            self.send_response(410)
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        # a single replica makes misclassification visible: if the
+        # corrupt 410 were treated as retryable-unadmitted, the retry
+        # would find no sibling and the outcome would be Overloaded —
+        # ReplicaLost with zero retries proves no redispatch happened
+        router = FleetRouter(
+            [Replica("corrupt410", "127.0.0.1", srv.server_address[1])],
+            RouterConfig(poll_interval_s=10.0, honor_drain=False))
+        with pytest.raises(ReplicaLost, match="undecodable"):
+            router.submit(_feed())
+        acct = router.accounting()
+        assert acct["exact"] and acct["retries"] == 0 \
+            and acct["replica_lost"] == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_router_wire_connect_drop_retried_on_sibling(fleet1):
+    from paddle_tpu import monitor
+    from paddle_tpu.resilience import fault_plan_guard
+
+    router, _, fe = fleet1
+    router.add_replica(Replica("good2", "127.0.0.1", fe.port))
+    router.poll_now()
+    monitor.reset()
+    with fault_plan_guard("wire_connect:@1:drop") as plan:
+        assert router.submit(_feed())[0].shape == (1, 4)
+        assert ("wire_connect", 1, "drop") in plan.fired
+    acct = router.accounting()
+    assert acct["retries"] == 1 and acct["completed"] == 1 and acct["exact"]
+
+
+def test_router_stop_bounded_with_hung_healthz_poll():
+    """Satellite: a /healthz that never answers must not delay router
+    teardown past connect_timeout_s — stop() closes the poll socket."""
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)   # accepts connections, never answers
+    try:
+        router = FleetRouter(
+            [Replica("hung", "127.0.0.1", srv.getsockname()[1])],
+            RouterConfig(poll_interval_s=0.05, connect_timeout_s=30.0))
+        router.start()
+        time.sleep(0.3)   # a poll is now hung in the read
+        t0 = time.monotonic()
+        router.stop()
+        assert time.monotonic() - t0 < 40.0  # not 2x30s of timeouts
+        # with the 30s socket timeout, only the forced close explains a
+        # sub-timeout return once a poll is in flight
+    finally:
+        srv.close()
+
+
+def test_router_membership_add_remove_reassign():
+    router = FleetRouter([])    # an empty fleet is legal now
+    with pytest.raises(serving.Overloaded):
+        router.submit(_feed())
+    r = router.add_replica(("a", "127.0.0.1", 1234))
+    assert router.get_replica("a") is r and r.breaker is not None
+    with pytest.raises(ValueError):
+        router.add_replica(("a", "127.0.0.1", 99))
+    old_breaker = r.breaker
+    router._breaker_failure(r)
+    router.reassign_replica("a", "127.0.0.1", 4321)
+    assert r.port == 4321
+    assert r.breaker is not old_breaker          # fresh capacity
+    assert router.remove_replica("a") is r
+    assert router.get_replica("a") is None
+    assert router.remove_replica("a") is None
+
+
+# ---------------------------------------------------------------------------
+# frontend wire faults: stream drop/corrupt surfaced typed by the router
+# ---------------------------------------------------------------------------
+
+class _CorruptStreamReplica:
+    """Minimal generative-ish front-end: healthz advertises generative,
+    /v1/generate streams two token chunks, then a corrupt one."""
+
+    def __init__(self, mode="corrupt"):
+        outer = self
+
+        class _H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                raw = wire.dumps({"schema_version": 1, "status": "ok",
+                                  "ready": True, "queue_depth": 0,
+                                  "degraded": False, "open_buckets": [],
+                                  "generative": True})
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(b):
+                    self.wfile.write(f"{len(b):x}\r\n".encode() + b
+                                     + b"\r\n")
+                    self.wfile.flush()
+
+                chunk(wire.dumps({"tokens": [1]}) + b"\n")
+                chunk(wire.dumps({"tokens": [2]}) + b"\n")
+                if outer.mode == "corrupt":
+                    chunk(b"\xffgarbage\n")
+                    chunk(b"0\r\n\r\n"[:0] or b"x")  # keep stream open
+                else:   # drop: sever without a terminal chunk
+                    self.connection.close()
+
+        self.mode = mode
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+        self.port = self.server.server_address[1]
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.mark.parametrize("mode", ["corrupt", "drop"])
+def test_stream_corruption_and_drop_surface_typed_after_partials(mode):
+    rep = _CorruptStreamReplica(mode)
+    try:
+        router = FleetRouter(
+            [Replica("g", "127.0.0.1", rep.port)],
+            RouterConfig(poll_interval_s=10.0, request_timeout_s=10.0))
+        router.poll_now()
+        it = router.generate([1, 2, 3], max_new_tokens=8)
+        got = []
+        with pytest.raises(ReplicaLost):
+            for t in it:
+                got.append(t)
+        assert got == [1, 2]          # partials delivered, then typed
+        acct = router.accounting()
+        assert acct["exact"] and acct["replica_lost"] == 1
+    finally:
+        rep.close()
+
+
+# ---------------------------------------------------------------------------
+# supervisor (stub processes — no jax import per spawn)
+# ---------------------------------------------------------------------------
+
+_STUB = r"""
+import json, signal, sys, time
+mode = sys.argv[1]
+if mode == "neverready":
+    time.sleep(600)
+print(json.dumps({"event": "ready", "replica_id": "s", "port": 18999,
+                  "time_to_ready_s": 0.01}), flush=True)
+if mode == "crash":
+    time.sleep(0.1)
+    print(json.dumps({"event": "exit", "replica_id": "s",
+                      "reason": "crash", "error": "boom"}), flush=True)
+    sys.exit(21)
+if mode == "crash_once":
+    import os
+    marker = sys.argv[2]
+    if not os.path.exists(marker):
+        open(marker, "w").close()
+        time.sleep(0.1)
+        print(json.dumps({"event": "exit", "replica_id": "s",
+                          "reason": "crash", "error": "boom"}), flush=True)
+        sys.exit(21)
+def term(*a):
+    print(json.dumps({"event": "exit", "replica_id": "s",
+                      "reason": "drain", "accounting": {}}), flush=True)
+    sys.exit(0)
+signal.signal(signal.SIGTERM, term)
+while True:
+    time.sleep(0.05)
+"""
+
+
+@pytest.fixture
+def stub(tmp_path):
+    path = tmp_path / "stub_replica.py"
+    path.write_text(_STUB)
+
+    def cmd(mode, *extra):
+        return lambda h: [sys.executable, str(path), mode,
+                          *[str(e) for e in extra]]
+
+    return cmd, tmp_path
+
+
+def _sup_cfg(**kw):
+    kw.setdefault("max_restarts", 2)
+    kw.setdefault("restart_window_s", 30.0)
+    kw.setdefault("backoff_base_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.2)
+    kw.setdefault("ready_timeout_s", 15.0)
+    kw.setdefault("exit_grace_s", 5.0)
+    return SupervisorConfig(**kw)
+
+
+def test_supervisor_registers_ready_replica_with_router(stub):
+    cmd, tmp = stub
+    router = FleetRouter([])
+    sup = ReplicaSupervisor(router, _sup_cfg(), log_dir=str(tmp),
+                            spawn_command=cmd("ok"))
+    try:
+        h = sup.add_replica("s0")
+        info = h.wait_ready(15)
+        assert info["port"] == 18999
+        assert router.get_replica("s0").port == 18999
+        assert h.state == "ready"
+    finally:
+        sup.stop()
+
+
+def test_supervisor_graceful_drain_never_restarts(stub):
+    cmd, tmp = stub
+    sup = ReplicaSupervisor(None, _sup_cfg(), log_dir=str(tmp),
+                            spawn_command=cmd("ok"))
+    try:
+        h = sup.add_replica("s0")
+        h.wait_ready(15)
+        sup.drain("s0")
+        h.thread.join(15)
+        assert h.state == "stopped" and h.restarts == 0
+        assert h.last_exit["reason"] == "drain"
+    finally:
+        sup.stop()
+
+
+def test_supervisor_restarts_crashed_replica_with_backoff(stub):
+    cmd, tmp = stub
+    marker = tmp / "crashed_once"
+    router = FleetRouter([])
+    sup = ReplicaSupervisor(router, _sup_cfg(), log_dir=str(tmp),
+                            spawn_command=cmd("crash_once", marker))
+    try:
+        h = sup.add_replica("s0")
+        h.wait_ready(15)           # first incarnation
+        deadline = time.monotonic() + 20
+        while (h.restarts < 1 or h.state != "ready") \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert h.restarts == 1 and h.state == "ready", h.status()
+        assert h.last_exit["reason"] == "crash"
+        assert h.error is None
+        # one restart event with a backoff in the audit trail
+        assert any(k == "restart" for _, k, _d in h.events)
+    finally:
+        sup.stop()
+
+
+def test_supervisor_crash_loop_retires_typed(stub):
+    cmd, tmp = stub
+    router = FleetRouter([])
+    sup = ReplicaSupervisor(router, _sup_cfg(), log_dir=str(tmp),
+                            spawn_command=cmd("crash"))
+    try:
+        h = sup.add_replica("s0")
+        assert h.wait_retired(30), h.status()
+        assert h.state == "retired"
+        assert isinstance(h.error, ReplicaCrashLoop)
+        assert h.error.replica == "s0"
+        assert h.restarts == sup.config.max_restarts
+        assert h.spawns == sup.config.max_restarts + 1
+        assert router.get_replica("s0") is None   # deregistered
+        with pytest.raises(ReplicaCrashLoop):
+            sup.check()
+        with pytest.raises(ReplicaCrashLoop):
+            h.wait_ready(5)        # fail fast, typed — never a spin
+    finally:
+        sup.stop()
+
+
+def test_supervisor_negative_control_spawn_once(stub):
+    cmd, tmp = stub
+    sup = ReplicaSupervisor(None, _sup_cfg(restart=False),
+                            log_dir=str(tmp), spawn_command=cmd("crash"))
+    try:
+        h = sup.add_replica("s0")
+        h.thread.join(20)
+        assert h.state == "down" and h.restarts == 0 and h.spawns == 1
+        # wait_ready on a replica that will never come fails loudly
+        # instead of spinning (even with no timeout deadline)
+        with pytest.raises(RuntimeError, match="will not become ready"):
+            h.wait_ready()
+    finally:
+        sup.stop()
+
+
+def test_supervisor_kill_classification(stub):
+    cmd, tmp = stub
+    sup = ReplicaSupervisor(None, _sup_cfg(max_restarts=5),
+                            log_dir=str(tmp), spawn_command=cmd("ok"))
+    try:
+        h = sup.add_replica("s0")
+        h.wait_ready(15)
+        sup.kill("s0")             # SIGKILL: no exit event
+        deadline = time.monotonic() + 20
+        while h.restarts < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert h.restarts == 1, h.status()
+        assert h.last_exit["reason"] == "kill"
+    finally:
+        sup.stop()
+
+
+# ---------------------------------------------------------------------------
+# replica worker: the crash path emits the exit event (satellite)
+# ---------------------------------------------------------------------------
+
+def test_replica_crash_path_emits_exit_event(monkeypatch, capsys):
+    from paddle_tpu.serving.fleet import replica as replica_mod
+
+    def boom(name, config):
+        raise RuntimeError("probe exploded")
+
+    monkeypatch.setattr(replica_mod, "build_probe", boom)
+    rc = replica_mod.main(["--model", "mlp_tiny", "--replica-id", "rc1"])
+    assert rc == 21
+    events = [json.loads(l) for l in
+              capsys.readouterr().out.strip().splitlines() if l]
+    exits = [e for e in events if e.get("event") == "exit"]
+    assert exits and exits[-1]["reason"] == "crash"
+    assert "probe exploded" in exits[-1]["error"]
+    assert exits[-1]["replica_id"] == "rc1"

@@ -371,9 +371,10 @@ def test_no_faults_zero_sheds_zero_rejections():
     acct = eng.accounting()
     recent = acct.pop("recent_outcomes")
     assert acct == {"submitted": 20, "completed": 20, "failed": 0,
-                    "shed": 0, "deadline_exceeded": 0, "circuit_open": 0,
-                    "rejected_fault": 0, "rejected_stopped": 0,
-                    "pending": 0, "accounted": 20, "exact": True}
+                    "poisoned": 0, "shed": 0, "deadline_exceeded": 0,
+                    "circuit_open": 0, "rejected_fault": 0,
+                    "rejected_stopped": 0, "pending": 0, "accounted": 20,
+                    "exact": True}
     # every terminal outcome is attributable (trace ids are "" with
     # FLAGS_trace off, but the outcome ring is always kept)
     assert len(recent) == 20

@@ -741,6 +741,418 @@ def leg_fleet_negative(name, ci, log_dir="."):
 
 
 # ---------------------------------------------------------------------------
+# fleet self-healing legs (--fleet-chaos): supervisor + bisection + wire
+# chaos — ISSUE 15's gate. Three failure families against a 2-replica
+# fleet: injected wire faults (drop + stall + corrupt), one poison
+# request co-batched with innocents, and a crashed + a crash-looping
+# replica under the supervisor.
+# ---------------------------------------------------------------------------
+
+_BISECT_FLAGS = ["--set-flag", "FLAGS_serving_bisect_depth=3",
+                 "--set-flag", "FLAGS_check_nan_inf=1"]
+
+
+def _chaos_router(request_timeout_s=2.0):
+    from paddle_tpu.serving.fleet import FleetRouter, RouterConfig
+
+    return FleetRouter([], RouterConfig(
+        poll_interval_s=0.1, connect_timeout_s=3.0,
+        request_timeout_s=request_timeout_s,
+        breaker_threshold=2, breaker_cooldown_s=0.4))
+
+
+def _chaos_supervisor(router, log_dir, restart=True, max_restarts=2):
+    from paddle_tpu.serving.fleet import (ReplicaSupervisor,
+                                          SupervisorConfig)
+
+    cfg = SupervisorConfig(max_restarts=max_restarts,
+                           restart_window_s=60.0, backoff_base_s=0.25,
+                           backoff_max_s=1.0, ready_timeout_s=240.0,
+                           exit_grace_s=30.0, restart=restart)
+    return ReplicaSupervisor(router, cfg, log_dir=log_dir,
+                             env=_replica_env(), cwd=_REPO_ROOT)
+
+
+def _wait_routable(router, replica_id, timeout=90.0):
+    """Wait until the router's snapshot marks one replica ok+ready (the
+    'fresh capacity within one poll' observation point)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        r = router.get_replica(replica_id)
+        if r is not None:
+            snap = r.snapshot()
+            if snap["ok"] and snap["ready"]:
+                return True
+        time.sleep(0.05)
+    return False
+
+
+def _wait_removed(router, replica_id, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if router.get_replica(replica_id) is None:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _poison_feed(seed=999):
+    f = _mlp_feed(rows=1, seed=seed)
+    f["img"][0, :7] = np.nan
+    return f
+
+
+def _submit_concurrent(router, feeds, priority=1):
+    """Submit each feed from its own thread (so the replica's batch
+    window coalesces them) and classify every outcome."""
+    from paddle_tpu.serving import (BatchFailed, CircuitOpen,
+                                    DeadlineExceeded, EngineStopped,
+                                    Overloaded, PoisonRequest)
+    from paddle_tpu.serving.fleet import ReplicaLost
+
+    results = [None] * len(feeds)
+    outcomes = [None] * len(feeds)
+
+    def one(i):
+        try:
+            results[i] = router.submit(feeds[i], priority=priority)
+            outcomes[i] = "completed"
+        except PoisonRequest:
+            outcomes[i] = "poisoned"
+        except Overloaded:
+            outcomes[i] = "shed"
+        except BatchFailed:
+            outcomes[i] = "failed"
+        except ReplicaLost:
+            outcomes[i] = "replica_lost"
+        except DeadlineExceeded:
+            outcomes[i] = "deadline"
+        except (CircuitOpen, EngineStopped):
+            outcomes[i] = "rejected"
+        except Exception:
+            outcomes[i] = "other_error"
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(len(feeds))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    return results, outcomes
+
+
+def leg_fleet_chaos_wire_poison(name, ci, log_dir=".", aot_dir=""):
+    """Wire chaos + poison bisection against a supervised 2-replica
+    fleet. r1 carries its OWN fault plan (its first two submit responses
+    stall past the router's request timeout — the stalling-but-listening
+    replica the per-replica breaker must eject); the router process
+    injects a connect drop and a corrupt request payload (both
+    unadmitted, absorbed by the sibling retry). Then one NaN poison
+    request rides a batch with innocents: replica-side bisection must
+    complete every innocent bit-exactly, settle the culprit typed
+    PoisonRequest, and shed its resubmission from quarantine."""
+    from paddle_tpu import monitor
+    from paddle_tpu.resilience import fault_plan_guard
+    from paddle_tpu.serving import Overloaded
+
+    router = _chaos_router(request_timeout_s=2.0)
+    sup = _chaos_supervisor(router, log_dir)
+    base_args = ["--batch-window-s", "0.02", "--max-batch", "4",
+                 "--queue-depth", "256"] + _BISECT_FLAGS
+    try:
+        sup.add_replica("r0", "mlp_tiny", aot_dir, extra_args=base_args)
+        sup.add_replica(
+            "r1", "mlp_tiny", aot_dir,
+            extra_args=base_args + [
+                "--set-flag", "FLAGS_fault_plan=wire_response:2:stall",
+                "--set-flag", "FLAGS_fault_stall_s=8"])
+        sup.handle("r0").wait_ready(240)
+        sup.handle("r1").wait_ready(240)
+        router.start()
+        assert _wait_routable(router, "r0") and _wait_routable(router, "r1")
+
+        # -- phase S: the stalling-but-listening replica ----------------
+        # SEQUENTIAL submissions so the breaker ladder is deterministic:
+        # r1's first two responses stall (its own fault plan) past the
+        # router timeout — they are necessarily its first two recorded
+        # transport outcomes, so two consecutive failures OPEN the
+        # breaker before any r1 success could reset the count
+        probe = {"completed": 0, "replica_lost": 0, "other": 0}
+        from paddle_tpu.serving.fleet import ReplicaLost as _RL
+        for i in range(12):
+            try:
+                router.submit(_mlp_feed(rows=1, seed=700 + i))
+                probe["completed"] += 1
+            except _RL:
+                probe["replica_lost"] += 1
+            except Exception:
+                probe["other"] += 1
+            if probe["replica_lost"] >= 2:
+                break
+        opened = monitor.metric_value("router_breaker_transitions_total",
+                                      0.0, replica="r1", to="open")
+        # cooldown + healthz half-open probe must READMIT r1
+        r1 = router.get_replica("r1")
+        deadline = time.time() + 15.0
+        while r1.breaker.state != "closed" and time.time() < deadline:
+            time.sleep(0.05)
+        readmitted = r1.breaker.state == "closed"
+
+        # -- phase W: burst under router-side wire faults ---------------
+        n = 24 if ci else 72
+        with fault_plan_guard("wire_connect:@9:drop,"
+                              "wire_connect:@12:corrupt") as plan:
+            seen = _drive_fleet(router, _mlp_feed, n_requests=n,
+                                n_threads=4)
+            wire_fired = list(plan.fired)
+
+        # -- phase P: poison bisection through the fleet ----------------
+        # all traffic onto r1: r0 drains away (also proves the breaker
+        # re-admitted r1 after its cooldown probe). Three rounds of one
+        # poison co-batched with one innocent: bisection re-dispatches
+        # the innocent as a SOLO batch, so a solo clean resubmission is
+        # the exact same executable + bucket — a true bit-exactness
+        # baseline (cross-bucket XLA results differ in ULPs by design).
+        sup.drain("r0")
+        assert _wait_removed(router, "r0"), "drained r0 not deregistered"
+        rounds = 3
+        poison_outcomes, innocent_outcomes = [], []
+        bit_exact = True
+        for j in range(rounds):
+            poison = _poison_feed(seed=990 + j)   # distinct fingerprints
+            innocent = _mlp_feed(rows=1, seed=100 + j)
+            results, outcomes = _submit_concurrent(router,
+                                                   [poison, innocent])
+            poison_outcomes.append(outcomes[0])
+            innocent_outcomes.append(outcomes[1])
+            if outcomes[1] == "completed":
+                clean = router.submit(innocent)
+                bit_exact = bit_exact and all(
+                    np.array_equal(a, b)
+                    for a, b in zip(clean, results[1]))
+            else:
+                bit_exact = False
+        # quarantine: the round-0 poison feed again is shed at admission
+        try:
+            router.submit(_poison_feed(seed=990))
+            quarantine_shed = False
+        except Overloaded:
+            quarantine_shed = True
+        except Exception:
+            quarantine_shed = False
+        acct = router.accounting()
+        sup.stop(drain=True)
+        router.stop()
+        victim = (sup.handle("r1").exit_info or {}).get("accounting", {})
+
+        checks = {
+            "exact_fleet_accounting": bool(acct["exact"]),
+            "every_submit_terminal": seen["terminal"] == seen["submitted"],
+            "no_untyped_errors": seen["other_error"] == 0,
+            # the two stalled responses were typed losses; everything
+            # else in the probe completed on the healthy sibling
+            "stalled_requests_typed_lost":
+                probe["replica_lost"] == 2 and probe["other"] == 0,
+            "stalling_replica_ejected": opened >= 1,
+            "breaker_readmitted_via_healthz": readmitted,
+            # with the stall plan exhausted and the breaker closed, the
+            # burst completes 100% (drop/corrupt retried on the sibling)
+            "wire_burst_completed":
+                seen["completed"] == n and seen["replica_lost"] == 0,
+            "unadmitted_wire_faults_retried": acct["retries"] >= 2,
+            "wire_faults_audited":
+                sum(1 for f in wire_fired if f[0] == "wire_connect") == 2,
+            "poison_isolated_typed":
+                all(o == "poisoned" for o in poison_outcomes),
+            "innocents_complete":
+                all(o == "completed" for o in innocent_outcomes),
+            "innocents_bit_exact": bit_exact,
+            "quarantine_sheds_repeat": quarantine_shed,
+            "victim_ledger_exact": bool(victim.get("exact")),
+            "victim_poisoned_per_round": victim.get("poisoned") == rounds,
+            # bisection saved every innocent: the victim never failed a
+            # whole batch
+            "victim_zero_batch_failures": victim.get("failed") == 0,
+        }
+        return {"name": name, "ok": all(checks.values()), "requests": n,
+                "caller_view": seen, "stall_probe": probe,
+                "router_accounting": acct,
+                "poison_outcomes": poison_outcomes,
+                "innocent_outcomes": innocent_outcomes,
+                "victim_accounting": victim,
+                "wire_fired": [list(f) for f in wire_fired],
+                "breaker_opens_r1": opened, "checks": checks,
+                "why": "drop+stall+corrupt wire faults + one poison "
+                       "request: typed outcomes for everything, "
+                       "innocents bit-exact via bisection, stalling "
+                       "replica ejected by the router breaker"}
+    finally:
+        sup.stop(drain=False)
+        router.stop()
+
+
+def leg_fleet_chaos_supervisor(name, ci, log_dir=".", aot_dir=""):
+    """Supervisor self-healing: r1 is SIGKILLed mid-burst (no exit
+    event — the 'kill' classification) and must be restarted within the
+    backoff budget, re-registered under the same id on a NEW port, and
+    serve again as the only ready replica. A third replica crash-loops
+    on purpose and must be RETIRED with a typed ReplicaCrashLoop, never
+    a silent restart spin."""
+    from paddle_tpu import monitor
+    from paddle_tpu.serving.fleet import ReplicaCrashLoop
+
+    router = _chaos_router(request_timeout_s=10.0)
+    sup = _chaos_supervisor(router, log_dir, max_restarts=2)
+    base_args = ["--batch-window-s", "0.005", "--max-batch", "4",
+                 "--queue-depth", "256"]
+    try:
+        sup.add_replica("r0", "mlp_tiny", aot_dir, extra_args=base_args)
+        sup.add_replica("r1", "mlp_tiny", aot_dir, extra_args=base_args)
+        sup.handle("r0").wait_ready(240)
+        sup.handle("r1").wait_ready(240)
+        router.start()
+        assert _wait_routable(router, "r0") and _wait_routable(router, "r1")
+
+        # -- phase K: SIGKILL r1 mid-burst, supervisor must heal --------
+        n = 24 if ci else 72
+        t_kill = [None]
+
+        def killer():
+            t_kill[0] = time.perf_counter()
+            sup.kill("r1")
+
+        seen = _drive_fleet(router, _mlp_feed, n_requests=n, n_threads=4,
+                            kill_at=n // 3, kill_fn=killer)
+        # wait for the ACTUAL restart (the pre-kill pressure snapshot is
+        # stale for up to one poll — the supervisor's own state is the
+        # ground truth), then for the router to see the new port ready
+        h1 = sup.handle("r1")
+        deadline = time.time() + 90.0
+        while (h1.restarts < 1 or h1.state != "ready") \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        restarted = (h1.restarts == 1 and h1.state == "ready"
+                     and _wait_routable(router, "r1", timeout=30.0))
+        restart_s = (time.perf_counter() - t_kill[0]
+                     if t_kill[0] is not None else None)
+        # only the RESTARTED replica left: its service proves the router
+        # treats same-id/new-port as fresh capacity
+        sup.drain("r0")
+        assert _wait_removed(router, "r0"), "drained r0 not deregistered"
+        k = 6
+        _, outcomes = _submit_concurrent(
+            router, [_mlp_feed(rows=1, seed=500 + i) for i in range(k)])
+
+        # -- phase L: forced crash loop must retire typed ---------------
+        sup.add_replica("r2", "mlp_tiny", aot_dir,
+                        extra_args=base_args + ["--crash-after-s", "0.4"])
+        h2 = sup.handle("r2")
+        retired = h2.wait_retired(240)
+        try:
+            sup.check()
+            retired_typed = False
+        except ReplicaCrashLoop:
+            retired_typed = True
+        # the fleet keeps serving through the whole crash loop
+        _, outcomes2 = _submit_concurrent(
+            router, [_mlp_feed(rows=1, seed=600 + i) for i in range(3)])
+        acct = router.accounting()
+        restarts_crash = monitor.metric_value(
+            "supervisor_restarts_total", 0.0, reason="crash")
+        restarts_kill = monitor.metric_value(
+            "supervisor_restarts_total", 0.0, reason="kill")
+
+        checks = {
+            "exact_fleet_accounting": bool(acct["exact"]),
+            "every_submit_terminal": seen["terminal"] == seen["submitted"],
+            "no_untyped_errors": seen["other_error"] == 0,
+            "nothing_admitted_lost_to_routing":
+                seen["stopped"] == 0 and seen["failed"] == 0,
+            "burst_progressed": seen["completed"] > 0,
+            "kill_classified": (h1.last_exit or {}).get("reason") == "kill",
+            "restarted_within_budget": restarted and h1.restarts == 1,
+            "restarted_replica_serves":
+                all(o == "completed" for o in outcomes),
+            "restart_counted": restarts_kill >= 1,
+            "crash_loop_retired": retired and h2.state == "retired",
+            "crash_loop_typed": retired_typed
+                and isinstance(h2.error, ReplicaCrashLoop),
+            "crash_loop_restarts_bounded": h2.restarts == 2,
+            "crash_restarts_counted": restarts_crash >= 2,
+            "retired_deregistered": router.get_replica("r2") is None,
+            "fleet_serves_through_crash_loop":
+                all(o == "completed" for o in outcomes2),
+        }
+        return {"name": name, "ok": all(checks.values()), "requests": n,
+                "caller_view": seen, "router_accounting": acct,
+                "restart_elapsed_s": restart_s,
+                "victim_status": h1.status(),
+                "crashloop_status": h2.status(), "checks": checks,
+                "why": "SIGKILLed replica restarted warm under the same "
+                       "id within the backoff budget; forced crash loop "
+                       "retired typed; fleet ledger exact throughout"}
+    finally:
+        sup.stop(drain=False)
+        router.stop()
+
+
+def leg_fleet_chaos_negative(name, ci, log_dir=".", aot_dir=""):
+    """--fleet-chaos --negative-control: supervision (restarts) and
+    bisection BOTH disabled. The poison request must fail its innocent
+    batch mates, and the killed replica must stay dead — the gate's
+    checks must provably FAIL."""
+    router = _chaos_router(request_timeout_s=5.0)
+    sup = _chaos_supervisor(router, log_dir, restart=False)
+    # bisection off (default), nan checks on: the poison still kills
+    # its batch — but now the whole batch dies with it
+    base_args = ["--batch-window-s", "0.02", "--max-batch", "4",
+                 "--queue-depth", "256",
+                 "--set-flag", "FLAGS_check_nan_inf=1"]
+    try:
+        sup.add_replica("r0", "mlp_tiny", aot_dir, extra_args=base_args)
+        sup.add_replica("r1", "mlp_tiny", aot_dir, extra_args=base_args)
+        sup.handle("r0").wait_ready(240)
+        sup.handle("r1").wait_ready(240)
+        router.start()
+        assert _wait_routable(router, "r0") and _wait_routable(router, "r1")
+
+        # poison WITHOUT bisection: innocents die with the culprit
+        sup.drain("r0")
+        assert _wait_removed(router, "r0")
+        feeds = [_poison_feed()] + [_mlp_feed(rows=1, seed=100 + i)
+                                    for i in range(6)]
+        _, outcomes = _submit_concurrent(router, feeds)
+
+        # kill WITHOUT restart: the replica stays dead, the fleet is gone
+        sup.kill("r1")
+        time.sleep(2.0)
+        restarted = _wait_routable(router, "r1", timeout=5.0)
+        _, outcomes2 = _submit_concurrent(
+            router, [_mlp_feed(rows=1, seed=500 + i) for i in range(4)])
+        acct = router.accounting()
+
+        checks = {
+            "poison_isolated_typed": outcomes[0] == "poisoned",
+            "innocents_complete":
+                all(o == "completed" for o in outcomes[1:]),
+            "restarted_within_budget": restarted,
+            "restarted_replica_serves":
+                all(o == "completed" for o in outcomes2),
+        }
+        return {"name": name, "ok": all(checks.values()),
+                "requests": len(feeds), "caller_view": {},
+                "poison_outcomes": outcomes,
+                "post_kill_outcomes": outcomes2,
+                "router_accounting": acct, "checks": checks,
+                "why": "restarts + bisection disabled: innocents must "
+                       "fail with the poison and the killed replica must "
+                       "stay dead — the gate must FAIL"}
+    finally:
+        sup.stop(drain=False)
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -770,6 +1182,17 @@ def main(argv=None) -> int:
                          "cold-vs-warm AOT-cache startup measurement. "
                          "With --negative-control the router runs without "
                          "drain honoring/retry and the gate must FAIL")
+    ap.add_argument("--fleet-chaos", action="store_true",
+                    help="run the fleet SELF-HEALING gate: a supervised "
+                         "2-replica fleet under injected wire faults "
+                         "(drop + stall + corrupt), one poison request "
+                         "isolated by batch bisection (innocents "
+                         "bit-exact), a SIGKILLed replica restarted warm "
+                         "within its backoff budget, and a forced crash "
+                         "loop retired with a typed ReplicaCrashLoop. "
+                         "With --negative-control the supervisor never "
+                         "restarts and bisection is off — the gate must "
+                         "FAIL")
     ap.add_argument("--log-dir", default=".",
                     help="where fleet replica stderr logs land")
     args = ap.parse_args(argv)
@@ -778,6 +1201,45 @@ def main(argv=None) -> int:
     monitor.reset()
     legs = []
     t0 = time.time()
+    if args.fleet_chaos:
+        aot_dir = tempfile.mkdtemp(prefix="paddle_tpu_fleet_chaos_aot_")
+        try:
+            if args.negative_control:
+                legs.append(leg_fleet_chaos_negative(
+                    "fleet_chaos_no_healing", ci, args.log_dir, aot_dir))
+            else:
+                legs.append(leg_fleet_chaos_wire_poison(
+                    "fleet_chaos_wire_poison", ci, args.log_dir, aot_dir))
+                legs.append(leg_fleet_chaos_supervisor(
+                    "fleet_chaos_supervisor", ci, args.log_dir, aot_dir))
+        finally:
+            shutil.rmtree(aot_dir, ignore_errors=True)
+        gate_ok = all(l["ok"] for l in legs)
+        for l in legs:
+            status = "ok" if l["ok"] else "MISS"
+            view = ", ".join(f"{k}={v}" for k, v in
+                             sorted(l.get("caller_view", {}).items()) if v)
+            print(f"[{status}] {l['name']}: {l['requests']} requests"
+                  + (f" -> {view}" if view else ""))
+            for k, v in sorted(l.get("checks", {}).items()):
+                if not v:
+                    print(f"       FAILED check: {k}")
+            if l.get("restart_elapsed_s") is not None:
+                print(f"supervisor: kill -> routable again in "
+                      f"{l['restart_elapsed_s']:.1f}s")
+        print(f"serving gate ({time.time() - t0:.1f}s) -> "
+              f"{'ok' if gate_ok else 'FAIL'}")
+        if args.json:
+            with open(args.json, "w", encoding="utf-8") as f:
+                json.dump({
+                    "legs": legs,
+                    "snapshot": monitor.snapshot(),
+                    "check": {"status": "ok" if gate_ok else "fail",
+                              "negative_control":
+                                  bool(args.negative_control)},
+                }, f, indent=2, default=str)
+            print(f"fleet-chaos artifact written to {args.json}")
+        return 0 if gate_ok else 1
     if args.fleet:
         if args.negative_control:
             legs.append(leg_fleet_negative("fleet_no_drain_honor", ci,
