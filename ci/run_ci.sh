@@ -22,6 +22,29 @@ echo "   dead-code findings gate; JSON report is the CI artifact)"
 JAX_PLATFORMS=cpu python tools/lint_program.py --zoo \
   --json "${CI_ARTIFACT_DIR:-.}/ci_lint_report.json" | tail -20
 
+echo "== concurrency lint gate (analysis/concurrency: lock inventory +"
+echo "   lock-order graph over the whole package; PT800 cycles, PT801"
+echo "   blocking-under-lock and PT802 unguarded cross-thread attrs gate"
+echo "   unless allowlisted with a reason; JSON report is the CI artifact"
+echo "   — the fleet-chaos leg later merges its runtime lock_witness"
+echo "   section into the same file)"
+JAX_PLATFORMS=cpu python tools/lint_concurrency.py \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_concurrency_report.json"
+echo "== concurrency lint negative control (broken fixtures, allowlist"
+echo "   off: the gate must FAIL on all of PT800/PT801/PT802)"
+CONC_NEG_LOG="${CI_ARTIFACT_DIR:-.}/ci_concurrency_negative.log"
+if JAX_PLATFORMS=cpu python tools/lint_concurrency.py \
+     --negative-control > "$CONC_NEG_LOG" 2>&1; then
+  echo "lint_concurrency did NOT fail on the broken fixtures" >&2
+  exit 1
+fi
+# non-zero exit must be the gate tripping, not the linter crashing
+if ! grep -q -- "-> FAIL" "$CONC_NEG_LOG"; then
+  echo "concurrency negative control exited non-zero WITHOUT tripping the gate:" >&2
+  tail -20 "$CONC_NEG_LOG" >&2
+  exit 1
+fi
+
 echo "== op-registry conformance audit (ops without a lower rule gate)"
 JAX_PLATFORMS=cpu python tools/audit_registry.py --strict \
   --json-file "${CI_ARTIFACT_DIR:-.}/ci_registry_audit.json" > /dev/null
@@ -153,8 +176,14 @@ echo "   sibling; a poison request co-batched with innocents is isolated by"
 echo "   bisection (innocents complete bit-exact, culprit typed PoisonRequest,"
 echo "   repeat offender quarantined); a SIGKILLed replica restarts warm under"
 echo "   the same id within its backoff budget; a forced crash loop retires"
-echo "   with a typed ReplicaCrashLoop)"
+echo "   with a typed ReplicaCrashLoop). Runs with FLAGS_lock_witness=1:"
+echo "   zero runtime lock-order cycles and every observed edge predicted"
+echo "   by the static graph also gate; the runtime lock_witness section"
+echo "   (wait/hold histograms per named lock) lands in"
+echo "   ci_concurrency_report.json"
 JAX_PLATFORMS=cpu python tools/load_check.py --ci --fleet-chaos \
+  --lock-witness \
+  --concurrency-json "${CI_ARTIFACT_DIR:-.}/ci_concurrency_report.json" \
   --log-dir "${CI_ARTIFACT_DIR:-.}" \
   --json "${CI_ARTIFACT_DIR:-.}/ci_fleet_chaos_report.json" | tail -10
 echo "== fleet self-healing negative control (supervisor restarts + bisection"

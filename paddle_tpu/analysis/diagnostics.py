@@ -186,6 +186,25 @@ CODES = {
               "fusion refused: an op between the chain's ops rewrites a "
               "var the chain reads — the fused op's relocated reads "
               "would see the redefined value"),
+    # -- source-level concurrency analysis (analysis/concurrency.py) ----
+    # These three codes lint the framework's own Python source (lock
+    # attributes, with-regions, thread entry points), not a Program IR;
+    # Diagnostic.site carries file:line instead of an op_callstack.
+    "PT800": (Severity.ERROR,
+              "lock-order cycle: the static lock-order graph (nested "
+              "with-regions + calls made while holding a lock) contains "
+              "a cycle — two threads taking the locks in opposing order "
+              "deadlock"),
+    "PT801": (Severity.WARNING,
+              "blocking call under a held lock: time.sleep, socket/HTTP "
+              "I/O, subprocess waits, Event.wait() without timeout, "
+              "block_until_ready or an unbounded queue op runs while a "
+              "lock is held — every other thread needing the lock stalls "
+              "for the full blocking duration"),
+    "PT802": (Severity.WARNING,
+              "unguarded cross-thread attribute: reachable from more "
+              "than one thread entry point with at least one write and "
+              "at least one access outside any lock region"),
 }
 
 

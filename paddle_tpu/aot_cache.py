@@ -44,6 +44,8 @@ import pickle
 import threading
 from typing import Any, Optional, Tuple
 
+from .monitor.lockwitness import make_lock
+
 __all__ = ["executable_key", "load_executable", "save_executable",
            "cache_dir_flag", "cache_stats"]
 
@@ -55,7 +57,7 @@ _SUFFIX = ".aotx"
 # one warning per failure class per process — a broken cache dir must not
 # spam a serving replica's log at request rate
 _warned = set()
-_warned_lock = threading.Lock()
+_warned_lock = make_lock("aot_cache._warned_lock")
 
 
 def _warn_once(kind: str, msg: str, *args) -> None:

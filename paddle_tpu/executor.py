@@ -102,7 +102,7 @@ class Scope:
         # running the same executor: the var map is lock-guarded so a
         # concurrent set_var can never tear a read (CPython dicts are
         # GIL-atomic per op, but read-modify-write sequences are not)
-        self._lock = threading.RLock()
+        self._lock = _monitor.make_rlock("Scope._lock")
 
     def var(self, name: str):
         with self._lock:
@@ -247,7 +247,7 @@ class _CompiledStep:
         self._aot_cache_parts: Optional[tuple] = None
         # serializes the one-time AOT build when two threads race the same
         # step (serving dispatcher vs a user thread)
-        self._aot_lock = threading.Lock()
+        self._aot_lock = _monitor.make_lock("_CompiledStep._aot_lock")
 
 
 def analyze_block_io(block, feed_names: set, fetch_names) -> dict:
@@ -518,7 +518,7 @@ class Executor:
         # runs this executor from its dispatch thread while the owning
         # thread may still call run() — an unguarded dict resize mid-probe
         # or a torn counter would corrupt the compile cache
-        self._lock = threading.RLock()
+        self._lock = _monitor.make_rlock("Executor._lock")
 
     def _maybe_auto_remat(self, program: Program, feed, fetch_names):
         """FLAGS_auto_recompute entry shared by run / run_chained /

@@ -33,6 +33,14 @@ _DEFS: Dict[str, tuple] = {
                 "runtime metrics collection (paddle_tpu.monitor): executor "
                 "counters/histograms, step hooks, recompilation diagnostics "
                 "— docs/OBSERVABILITY.md. Off disables all collection"),
+    "lock_witness": (bool, False,
+                     "instrument the named framework locks "
+                     "(monitor.lockwitness factories): per-thread "
+                     "acquisition-order edges, wait/hold histograms and "
+                     "runtime lock-order cycle detection, gated against "
+                     "the static PT800 lock-order graph by "
+                     "tools/load_check.py --fleet-chaos. Off: the "
+                     "factories return plain threading primitives"),
     "log_compiles": (bool, False,
                      "log every executor compile (INFO) and recompile "
                      "(WARNING, with the changed cache-key component and "

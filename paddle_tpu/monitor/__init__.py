@@ -39,6 +39,9 @@ from typing import Any, Dict, Optional
 
 from .hooks import (CompileRecord, Hook, StepRecord, add_hook, clear_hooks,
                     dispatch, remove_hook)
+from .lockwitness import (make_condition, make_lock, make_rlock,
+                          reset_witness, witness_cycles, witness_edges,
+                          witness_enabled, witness_report)
 from .recompile import RecompileTracker, build_site, get_tracker
 from .registry import (DEFAULT_TIME_BUCKETS, Counter, Gauge, Histogram,
                        MetricFamily, MetricsRegistry, counter, gauge,
@@ -56,6 +59,8 @@ __all__ = [
     "observe_comms_cost",
     "recompile_events",
     "recompile_count", "snapshot", "reset", "get_tracker", "build_site",
+    "make_lock", "make_rlock", "make_condition", "witness_enabled",
+    "witness_report", "witness_edges", "witness_cycles", "reset_witness",
 ]
 
 _step_counter = itertools.count()

@@ -16,6 +16,8 @@ import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .lockwitness import make_lock
+
 __all__ = ["StepRecord", "CompileRecord", "Hook", "add_hook", "remove_hook",
            "clear_hooks", "dispatch"]
 
@@ -76,7 +78,7 @@ class Hook:
         self.on_compile = on_compile
 
 
-_lock = threading.Lock()
+_lock = make_lock("monitor.hooks._lock")
 _hooks: List[Hook] = []
 
 

@@ -28,6 +28,7 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from .hooks import CompileRecord
+from .lockwitness import make_lock
 
 __all__ = ["RecompileTracker", "build_site", "get_tracker"]
 
@@ -71,7 +72,7 @@ def _diff_detail(name: str, old, new) -> str:
 
 class RecompileTracker:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("RecompileTracker._lock")
         # (program serial, path) -> (n_compiles, last components, site).
         # Keyed per path: run and run_chained build different executable
         # kinds with different key components — crossing them would report

@@ -110,7 +110,7 @@ class CompiledProgram:
         # same contract as Executor._lock: the step cache must survive
         # concurrent dispatch threads (serving) without forking duplicate
         # compiles for one key
-        self._cache_lock = threading.RLock()
+        self._cache_lock = _monitor.make_rlock("CompiledProgram._cache_lock")
 
     @property
     def program(self) -> Program:

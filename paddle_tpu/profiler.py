@@ -24,6 +24,8 @@ import logging
 import threading
 import time
 from collections import defaultdict
+
+from .monitor.lockwitness import make_lock
 from typing import Optional
 
 import jax
@@ -35,7 +37,7 @@ log = logging.getLogger("paddle_tpu.profiler")
 
 # one lock for every piece of host-side profiling state: RecordEvent
 # exits on worker threads race stop_profiler's snapshot-and-clear
-_lock = threading.Lock()
+_lock = make_lock("profiler._lock")
 _trace_dir: Optional[str] = None
 _host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
 # (name, t0_s, t1_s, small_tid, epoch0_s) while profiling: t0/t1 are

@@ -111,10 +111,12 @@ class DataLoader:
         return self
 
     # -- device staging ----------------------------------------------------
-    def _stage(self, batch):
+    def _stage(self, batch, places):
         """Convert one batch to device arrays keyed by feed name. device_put
         is asynchronous: the host->device copy of batch N+1 overlaps the
-        compute of batch N (BufferedReader's double-buffer, compiler-free)."""
+        compute of batch N (BufferedReader's double-buffer, compiler-free).
+        ``places`` is the worker thread's snapshot taken at ``__iter__``
+        time — the prefetch thread never reads mutable loader state."""
         import jax
 
         if isinstance(batch, dict):
@@ -127,9 +129,9 @@ class DataLoader:
                     f"{len(self._feed_names)} ({self._feed_names})")
             items = list(zip(self._feed_names, vals))
         dev = None
-        if self._places:
-            place = self._places[0] if isinstance(self._places, (list, tuple)) \
-                else self._places
+        if places:
+            place = places[0] if isinstance(places, (list, tuple)) \
+                else places
             dev = place.jax_device() if hasattr(place, "jax_device") else place
         out = {}
         from ..data_feeder import coerce_feed_array
@@ -170,15 +172,21 @@ class DataLoader:
         skip = self._skip_batches
         self._skip_batches = 0
         self._batches_served = skip
+        # snapshot the mutable loader config BEFORE spawning the worker:
+        # Thread.start() is the happens-before edge, and the prefetch
+        # thread then only touches its own locals — a set_batch_generator
+        # call racing a live iterator can no longer tear the worker's view
+        batch_reader = self._batch_reader
+        places = self._places
 
         def worker():
             try:
-                for i, batch in enumerate(self._batch_reader()):
+                for i, batch in enumerate(batch_reader()):
                     if stop.is_set():
                         return
                     if i < skip:
                         continue  # fast-forward: resume mid-epoch
-                    q.put(self._stage(batch))
+                    q.put(self._stage(batch, places))
                 q.put(_EOE)
             except BaseException as e:  # surface in the consumer
                 q.put(e)

@@ -53,6 +53,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..monitor.lockwitness import make_lock
 from .faults import fault_point
 
 __all__ = ["ReplicaDivergenceError", "WatchdogTimeout", "watchdog_section",
@@ -582,7 +583,7 @@ class _Section:
     hard_deadline: Optional[float] = None
 
 
-_wd_lock = threading.Lock()
+_wd_lock = make_lock("resilience.distributed._wd_lock")
 _wd_armed: Dict[int, _Section] = {}
 _wd_tokens = itertools.count(1)
 _wd_thread: Optional[threading.Thread] = None

@@ -92,6 +92,8 @@ import random
 import re
 from typing import Dict, List, Optional
 
+from ..monitor.lockwitness import make_lock
+
 __all__ = ["FaultPlan", "InjectedFault", "fault_point", "fault_action",
            "stall", "install_plan", "clear_plan", "fault_plan_guard",
            "active_plan", "SITES", "WIRE_SITES", "DATA_ACTIONS"]
@@ -165,7 +167,7 @@ class FaultPlan:
         self.hits: Dict[str, int] = {}
         self.fired: List[tuple] = []   # (site, hit, action) audit trail
         self._rng = random.Random(self.seed)
-        self._lock = threading.Lock()
+        self._lock = make_lock("FaultPlan._lock")
         for part in filter(None, (p.strip() for p in self.spec.split(","))):
             m = _RULE_RE.match(part)
             if not m:

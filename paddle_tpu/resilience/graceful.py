@@ -34,13 +34,15 @@ import signal
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..monitor.lockwitness import make_lock
+
 __all__ = ["shutdown_event", "shutdown_requested", "request_shutdown",
            "on_shutdown", "install_signal_handlers",
            "uninstall_signal_handlers", "reset_shutdown_state"]
 
 logger = logging.getLogger("paddle_tpu.resilience")
 
-_lock = threading.Lock()
+_lock = make_lock("resilience.graceful._lock")
 _event = threading.Event()
 _reason: Optional[str] = None
 _callbacks: List[Callable[[], None]] = []

@@ -247,13 +247,14 @@ class ServingFuture:
 
     def __init__(self):
         self._event = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = _monitor.make_lock("ServingFuture._lock")
         self._result: Optional[List[np.ndarray]] = None
         self._error: Optional[BaseException] = None
         # streamed partial results (generative requests): guarded by
         # _lock, waiters ride the shared-lock condition
         self._tokens: List[Any] = []
-        self._stream_cond = threading.Condition(self._lock)
+        self._stream_cond = _monitor.make_condition(
+            "ServingFuture._stream_cond", self._lock)
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -381,8 +382,9 @@ class ServingEngine:
         self._exe = executor or Executor(place)
         self.config = (config or ServingConfig()).resolve()
 
-        self._lock = threading.Lock()
-        self._work = threading.Condition(self._lock)
+        self._lock = _monitor.make_lock("ServingEngine._lock")
+        self._work = _monitor.make_condition("ServingEngine._work",
+                                             self._lock)
         self._queue: List[_Request] = []
         self._running = False
         self._stopped = False

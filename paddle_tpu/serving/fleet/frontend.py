@@ -106,7 +106,8 @@ class ServingFrontend:
         self._server: Optional[_Server] = None
         self._thread: Optional[threading.Thread] = None
         self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = _monitor.make_lock(
+            "ServingFrontend._inflight_lock")
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> int:

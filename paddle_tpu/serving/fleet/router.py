@@ -110,7 +110,7 @@ class Replica:
         self.replica_id = replica_id
         self.host = host
         self.port = int(port)
-        self._lock = threading.Lock()
+        self._lock = _monitor.make_lock("Replica._lock")
         # per-replica transport circuit breaker; attached/reset by the
         # router (its config carries the thresholds)
         self.breaker: Optional[CircuitBreaker] = None
@@ -171,8 +171,8 @@ class FleetRouter:
         # an EMPTY fleet is legal since the supervisor era (replicas
         # register as they come ready); submits shed typed meanwhile
         self.replicas: List[Replica] = []
-        self._lock = threading.Lock()
-        self._breaker_lock = threading.Lock()
+        self._lock = _monitor.make_lock("FleetRouter._lock")
+        self._breaker_lock = _monitor.make_lock("FleetRouter._breaker_lock")
         for r in replicas:
             self.add_replica(r)
         self._rr = 0

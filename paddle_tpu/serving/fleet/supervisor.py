@@ -182,7 +182,7 @@ class ReplicaSupervisor:
         self.env = env
         self.cwd = cwd
         self._spawn_command = spawn_command or self._default_command
-        self._lock = threading.Lock()
+        self._lock = _monitor.make_lock("ReplicaSupervisor._lock")
         self._stop_ev = threading.Event()
         self.replicas: Dict[str, SupervisedReplica] = {}
 
@@ -209,12 +209,13 @@ class ReplicaSupervisor:
         return h
 
     def handle(self, replica_id: str) -> SupervisedReplica:
-        return self.replicas[replica_id]
+        with self._lock:   # add_replica resizes the dict concurrently
+            return self.replicas[replica_id]
 
     def drain(self, replica_id: str) -> None:
         """Graceful SIGTERM drain of one replica; the supervisor will
         NOT restart it."""
-        h = self.replicas[replica_id]
+        h = self.handle(replica_id)
         h.drain_requested = True
         h.stop_requested = True
         self._signal(h, signal.SIGTERM)
@@ -223,7 +224,7 @@ class ReplicaSupervisor:
         """Chaos helper: SIGKILL the replica process WITHOUT telling the
         supervisor — exactly what an OOM kill or host loss looks like,
         so the restart path is exercised for real."""
-        h = self.replicas[replica_id]
+        h = self.handle(replica_id)
         if h.proc is not None and h.proc.poll() is None:
             h.proc.kill()
 

@@ -44,6 +44,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from .. import flags as _flags
+from ..monitor.lockwitness import make_lock
 
 __all__ = [
     "Span", "SpanContext", "enabled", "span", "root_span", "start_span",
@@ -323,7 +324,7 @@ def start_span(name: str, parent=None, **attrs) -> Span:
     return _make_span(name, parent, attrs)
 
 
-def span(name: str, parent=None, **attrs):
+def span(name: str, parent=None, **attrs) -> "Span":
     """Context-manager form: ``with trace.span("executor.step", ...)``.
     No-op singleton when tracing is off."""
     if not enabled():
@@ -366,7 +367,7 @@ class SpanCollector:
     incident list. One module-level instance; thread-safe."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("SpanCollector._lock")
         self._spans: Optional[deque] = None
         self._flight: Optional[deque] = None
         self._incidents: deque = deque(maxlen=32)

@@ -43,6 +43,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..monitor.lockwitness import make_lock, make_rlock
+
 __all__ = [
     "CostDatabase", "TunedConfig", "autotune_mode", "default_db_path",
     "get_database", "program_content_fingerprint", "shape_bucket",
@@ -68,7 +70,7 @@ _SCHEMA = 1
 # traffic during a sweep still compiles under transient candidate flags;
 # see the measure_candidates docstring.
 _trial_depth = 0
-_trial_lock = threading.Lock()
+_trial_lock = make_lock("tuning._trial_lock")
 
 
 def in_trial() -> bool:
@@ -235,7 +237,7 @@ class CostDatabase:
 
     def __init__(self, path: str):
         self.path = path
-        self._lock = threading.RLock()
+        self._lock = make_rlock("CostDatabase._lock")
         self._entries: Optional[Dict[str, dict]] = None
 
     # -- keys ------------------------------------------------------------
@@ -373,7 +375,7 @@ class CostDatabase:
 
 
 _db_cache: Dict[str, CostDatabase] = {}
-_db_lock = threading.Lock()
+_db_lock = make_lock("tuning._db_lock")
 
 
 def get_database(path: Optional[str] = None) -> CostDatabase:

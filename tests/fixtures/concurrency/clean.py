@@ -1,0 +1,45 @@
+"""Negative control: concurrency idioms the linter must NOT flag.
+
+* consistent lock order (always ``_a`` before ``_b``) — no PT800;
+* ``Condition.wait`` under the condition's own lock and ``Event.wait``
+  with a timeout — neither is blocking-under-lock (PT801);
+* cross-thread state accessed only under the lock, including through a
+  ``*_locked`` helper only ever called with the lock held — no PT802.
+"""
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._cond = threading.Condition(self._a)
+        self._stop = threading.Event()
+        self.pending = []
+        self.done = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def submit(self, job):
+        with self._a:
+            self.pending.append(job)
+            self._cond.notify()
+            with self._b:     # consistent nesting order: always _a -> _b
+                pass
+
+    def _run(self):
+        while not self._stop.wait(timeout=0.01):
+            with self._a:
+                while not self.pending:
+                    self._cond.wait(timeout=0.1)
+                self._drain_locked()
+            with self._b:
+                pass
+
+    def _drain_locked(self):
+        self.pending.clear()
+        self.done += 1
+
+    def stats(self):
+        with self._a:
+            return self.done

@@ -1,0 +1,25 @@
+"""PT800 positive control: AB/BA lock-order cycle.
+
+``submit`` acquires ``_a`` then ``_b``; ``drain`` acquires ``_b`` then
+``_a``. Two threads running one each deadlock; the static lock-order
+graph has the cycle a->b->a and the linter must report PT800.
+"""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.jobs = []
+
+    def submit(self, job):
+        with self._a:
+            with self._b:
+                self.jobs.append(job)
+
+    def drain(self):
+        with self._b:
+            with self._a:
+                jobs, self.jobs = self.jobs, []
+        return jobs

@@ -1,0 +1,34 @@
+"""PT801 positive control: blocking calls under a held lock.
+
+The exact shape of the PR-13 aot_cache regression: a compile path that
+sleeps while holding the cache lock, serializing every other thread
+behind a wait that has nothing to do with them. ``get`` blocks
+directly; ``warm`` blocks transitively through the ``_backoff`` helper
+— the linter must flag both (the transitive case is the one a lexical
+grep misses).
+"""
+import threading
+import time
+
+
+class CompileCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}
+
+    def get(self, key):
+        with self._lock:
+            if key not in self._cache:
+                time.sleep(0.05)
+                self._cache[key] = object()
+            return self._cache[key]
+
+    def warm(self, keys):
+        with self._lock:
+            for k in keys:
+                if k not in self._cache:
+                    self._backoff()
+                    self._cache[k] = object()
+
+    def _backoff(self):
+        time.sleep(0.01)

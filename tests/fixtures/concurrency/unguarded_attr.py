@@ -1,0 +1,23 @@
+"""PT802 positive control: cross-thread attribute with unguarded access.
+
+``count`` is written by the worker thread (``_loop``) and read by the
+caller side (``snapshot``), neither under ``_lock`` — a data race the
+linter must report. ``__init__`` accesses do not count (construction
+happens-before ``Thread.start``).
+"""
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self.count += 1
+
+    def snapshot(self):
+        return self.count

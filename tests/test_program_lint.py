@@ -62,8 +62,10 @@ def test_book_model_programs_verify_clean():
 def test_every_code_is_documented_and_tested():
     # the CODES table is the single source of truth; this file (or
     # test_pass_manager.py, which owns the PT70x-PT72x pass-manager
-    # families, test_sharding_check.py, which owns PT73x, or
-    # test_epilogue_fusion.py, which owns PT75x) must cover every code
+    # families, test_sharding_check.py, which owns PT73x,
+    # test_epilogue_fusion.py, which owns PT75x, or
+    # test_concurrency_lint.py, which owns the source-level PT80x
+    # family) must cover every code
     import io
     import os
 
@@ -75,7 +77,9 @@ def test_every_code_is_documented_and_tested():
                   os.path.join(os.path.dirname(here),
                                "test_sharding_check.py"),
                   os.path.join(os.path.dirname(here),
-                               "test_epilogue_fusion.py")):
+                               "test_epilogue_fusion.py"),
+                  os.path.join(os.path.dirname(here),
+                               "test_concurrency_lint.py")):
         with io.open(fname, "r", encoding="utf-8") as f:
             me += f.read()
     assert len(CODES) >= 10

@@ -1156,6 +1156,66 @@ def leg_fleet_chaos_negative(name, ci, log_dir=".", aot_dir=""):
 # driver
 # ---------------------------------------------------------------------------
 
+def _witness_gate():
+    """Runtime lock-witness verdict for the artifact and the gate:
+    zero runtime lock-order cycles, and every observed edge between
+    framework-named locks predicted by the static graph
+    (paddle_tpu.analysis.concurrency). Returns (section, ok)."""
+    from paddle_tpu.analysis.concurrency import analyze_package
+
+    rep = monitor.witness_report()
+    static_rep = analyze_package()
+    static = static_rep.edge_set()
+    known = set(static_rep.locks) | {n for e in static for n in e}
+    runtime = sorted(monitor.witness_edges())
+    # only framework-named locks participate in the subset check:
+    # harness-local locks (this tool, test fixtures) are outside the
+    # static scan and prove nothing about the framework
+    framework = [e for e in runtime if e[0] in known and e[1] in known]
+    extra = sorted(set(framework) - static)
+    cycles = rep["cycles"]
+    ok = rep["enabled"] and not cycles and not extra
+    section = {
+        "enabled": rep["enabled"],
+        "locks": rep["locks"],
+        "runtime_edges": [list(e) for e in runtime],
+        "static_edges": sorted(list(e) for e in static),
+        "edges_not_in_static_graph": [list(e) for e in extra],
+        "runtime_cycles": cycles,
+        "ok": ok,
+    }
+    return section, ok
+
+
+def _print_witness(witness) -> None:
+    locks = witness["locks"]
+    tail = max((s["hold"]["p99"] or 0) for s in locks.values()) \
+        if locks else 0.0
+    print(f"lock witness: {len(locks)} locks, "
+          f"{len(witness['runtime_edges'])} runtime edges "
+          f"({len(witness['edges_not_in_static_graph'])} outside the "
+          f"static graph), {len(witness['runtime_cycles'])} cycle(s), "
+          f"worst hold p99 {tail * 1e3:.2f}ms")
+    for e in witness["edges_not_in_static_graph"]:
+        print(f"       UNPREDICTED edge: {e[0]} -> {e[1]}")
+    for c in witness["runtime_cycles"]:
+        print(f"       RUNTIME CYCLE: {' -> '.join(c)}")
+
+
+def _merge_concurrency_json(path, witness) -> None:
+    """Land the runtime section next to the static report so
+    ci_concurrency_report.json carries both halves of the gate."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    doc["lock_witness"] = witness
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+    print(f"lock_witness section merged into {path}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--ci", action="store_true",
@@ -1195,9 +1255,25 @@ def main(argv=None) -> int:
                          "FAIL")
     ap.add_argument("--log-dir", default=".",
                     help="where fleet replica stderr logs land")
+    ap.add_argument("--lock-witness", action="store_true",
+                    help="run with FLAGS_lock_witness=1: every named "
+                         "framework lock is instrumented, and after the "
+                         "legs the gate additionally requires zero "
+                         "runtime lock-order cycles and every observed "
+                         "edge to be predicted by the static graph "
+                         "(paddle_tpu.analysis.concurrency)")
+    ap.add_argument("--concurrency-json", metavar="PATH", default=None,
+                    help="merge the runtime lock_witness section into "
+                         "this existing lint_concurrency JSON artifact "
+                         "(ci_concurrency_report.json)")
     args = ap.parse_args(argv)
     ci = args.ci or args.check
 
+    if args.lock_witness:
+        # before any engine/router/supervisor construction: the factories
+        # read the flag once at lock-creation time
+        fluid.set_flags({"FLAGS_lock_witness": 1})
+        monitor.reset_witness()
     monitor.reset()
     legs = []
     t0 = time.time()
@@ -1215,6 +1291,11 @@ def main(argv=None) -> int:
         finally:
             shutil.rmtree(aot_dir, ignore_errors=True)
         gate_ok = all(l["ok"] for l in legs)
+        witness = None
+        if args.lock_witness:
+            witness, w_ok = _witness_gate()
+            if not args.negative_control:
+                gate_ok = gate_ok and w_ok
         for l in legs:
             status = "ok" if l["ok"] else "MISS"
             view = ", ".join(f"{k}={v}" for k, v in
@@ -1227,18 +1308,23 @@ def main(argv=None) -> int:
             if l.get("restart_elapsed_s") is not None:
                 print(f"supervisor: kill -> routable again in "
                       f"{l['restart_elapsed_s']:.1f}s")
+        if witness is not None:
+            _print_witness(witness)
         print(f"serving gate ({time.time() - t0:.1f}s) -> "
               f"{'ok' if gate_ok else 'FAIL'}")
         if args.json:
             with open(args.json, "w", encoding="utf-8") as f:
                 json.dump({
                     "legs": legs,
+                    "lock_witness": witness,
                     "snapshot": monitor.snapshot(),
                     "check": {"status": "ok" if gate_ok else "fail",
                               "negative_control":
                                   bool(args.negative_control)},
                 }, f, indent=2, default=str)
             print(f"fleet-chaos artifact written to {args.json}")
+        if args.concurrency_json and witness is not None:
+            _merge_concurrency_json(args.concurrency_json, witness)
         return 0 if gate_ok else 1
     if args.fleet:
         if args.negative_control:
