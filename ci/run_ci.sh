@@ -45,6 +45,38 @@ if ! grep -q -- "-> FAIL" "$CONC_NEG_LOG"; then
   exit 1
 fi
 
+echo "== numerics lint gate (analysis/numerics: interval + dtype-precision"
+echo "   flow over the model zoo incl. QAT-transformed variants; PT900"
+echo "   broken quant pairing and PT902 overflowing casts are errors,"
+echo "   PT901/PT903/PT904/PT905 warnings gate unless allowlisted; PT906"
+echo "   is the int8 quantizability work-list; JSON report is the CI"
+echo "   artifact)"
+JAX_PLATFORMS=cpu python tools/lint_numerics.py \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_numerics_report.json" | tail -12
+echo "== numerics lint negative control (broken fixtures, allowlist off:"
+echo "   the gate must FAIL on all of PT900..PT905)"
+NUM_NEG_LOG="${CI_ARTIFACT_DIR:-.}/ci_numerics_negative.log"
+if JAX_PLATFORMS=cpu python tools/lint_numerics.py \
+     --negative-control > "$NUM_NEG_LOG" 2>&1; then
+  echo "lint_numerics did NOT fail on the broken fixtures" >&2
+  exit 1
+fi
+# non-zero exit must be the gate tripping, not the linter crashing
+if ! grep -q -- "-> FAIL" "$NUM_NEG_LOG"; then
+  echo "numerics negative control exited non-zero WITHOUT tripping the gate:" >&2
+  tail -20 "$NUM_NEG_LOG" >&2
+  exit 1
+fi
+
+echo "== numerics witness cross-check (FLAGS_numerics_witness=1: jitted"
+echo "   per-var abs-max/min/max + nonfinite taps over short train+infer"
+echo "   runs of the zoo; every observed value must sit INSIDE its proven"
+echo "   static interval — tolerance-free containment, the lock-witness"
+echo "   idiom — and observed abs-max feeds PT906 calibration into"
+echo "   ci_numerics_report.json)"
+JAX_PLATFORMS=cpu python tools/lint_numerics.py --witness \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_numerics_report.json" | tail -8
+
 echo "== op-registry conformance audit (ops without a lower rule gate)"
 JAX_PLATFORMS=cpu python tools/audit_registry.py --strict \
   --json-file "${CI_ARTIFACT_DIR:-.}/ci_registry_audit.json" > /dev/null

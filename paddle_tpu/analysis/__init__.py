@@ -55,6 +55,9 @@ from .sharding_check import (CollectiveEvent, ShardingAnalysis,
                              propagate_sharding)
 from . import epilogue_fusion
 from .epilogue_fusion import (FusedChain, FusionDecision, fuse_epilogues)
+from . import numerics
+from .numerics import (Interval, NumericsReport, analyze_numerics,
+                       check_numerics, static_intervals)
 
 __all__ = [
     "CODES", "Diagnostic", "ProgramVerificationError", "Severity",
@@ -75,4 +78,6 @@ __all__ = [
     "sharding_check", "CollectiveEvent", "ShardingAnalysis",
     "propagate_sharding",
     "epilogue_fusion", "FusedChain", "FusionDecision", "fuse_epilogues",
+    "numerics", "Interval", "NumericsReport", "analyze_numerics",
+    "check_numerics", "static_intervals",
 ]

@@ -111,6 +111,19 @@ def _sharding_check_pass(program, ctx):
     return check_sharding(program, ctx)
 
 
+def _numerics_check_pass(program, ctx):
+    """Numerics/precision analysis (analysis/numerics.py, PT900-PT906):
+    value-interval + dtype-precision propagation over the recorded
+    infer_shape metadata, the quant/dequant pairing contract, AMP
+    loss-scale coverage and the PT906 quantizability work-list. Options:
+    ``numerics_calibration`` — witness-observed abs-max seeds. Like
+    sharding_check, findings-free programs pay one linear walk, so the
+    full lint pipeline always includes the pass."""
+    from .numerics import check_numerics
+
+    return check_numerics(program, ctx)
+
+
 def _epilogue_fusion_pass(program, ctx):
     """GEMM-epilogue fusion (analysis/epilogue_fusion.py, PT750-PT755):
     rewrite mul/matmul -> bias/activation/residual/layer_norm chains into
@@ -149,6 +162,8 @@ def register_builtins(reg: PassRegistry) -> None:
     reg.register(FunctionPass(_cost_model_pass, "cost_model", ANALYSIS))
     reg.register(FunctionPass(_sharding_check_pass, "sharding_check",
                               ANALYSIS, requires=("liveness",)))
+    reg.register(FunctionPass(_numerics_check_pass, "numerics_check",
+                              ANALYSIS))
     reg.register(FunctionPass(_auto_remat_pass, "auto_remat", TRANSFORM,
                               invalidates=("*",)))
     reg.register(FunctionPass(_epilogue_fusion_pass, "epilogue_fusion",

@@ -205,6 +205,38 @@ CODES = {
               "unguarded cross-thread attribute: reachable from more "
               "than one thread entry point with at least one write and "
               "at least one access outside any lock region"),
+    # -- numerics / precision analysis (analysis/numerics.py) -----------
+    "PT900": (Severity.ERROR,
+              "broken quant/dequant pairing: a fake-quant output is "
+              "consumed where the int8 rewrite contract does not hold "
+              "(non-GEMM consumer), or the quantized value is never "
+              "consumed at all"),
+    "PT901": (Severity.WARNING,
+              "dead or non-persistable moving-average scale state in a "
+              "training program: the running activation scale is not "
+              "persistable (reset every step) or its update is never "
+              "written back in place (the moving average never "
+              "advances)"),
+    "PT902": (Severity.ERROR,
+              "overflowing cast: the statically-proven value interval "
+              "exceeds the target dtype's finite range"),
+    "PT903": (Severity.WARNING,
+              "reduction accumulated in low precision: a reduce/"
+              "layer_norm-family op sums a float16/bfloat16 input into a "
+              "float16/bfloat16 output with no upcast around the "
+              "accumulation"),
+    "PT904": (Severity.WARNING,
+              "AMP loss-scale coverage gap: loss scaling is active "
+              "(check_finite_and_unscale present) but a gradient reaches "
+              "an optimizer update without passing through unscale"),
+    "PT905": (Severity.WARNING,
+              "nonfinite-producing op: log/sqrt/rsqrt/div on an interval "
+              "statically proven to contain 0 or negatives, with no "
+              "guard narrowing the operand first"),
+    "PT906": (Severity.INFO,
+              "quantizable GEMM/conv site: eligible for int8 epilogue "
+              "lowering (the quantizability work-list the int8 PR "
+              "consumes)"),
 }
 
 

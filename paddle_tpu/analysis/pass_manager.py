@@ -44,6 +44,7 @@ Built-in passes (docs/ANALYSIS.md has the full table):
 | donation_race     | analysis  | liveness    | PT71x donation/alias races |
 | dead_code         | analysis  | —           | PT72x transitively dead ops |
 | cost_model        | analysis  | —           | FLOP/byte CostReport (no diagnostics) |
+| numerics_check    | analysis  | —           | PT90x interval/precision flow + quantizability |
 | auto_remat        | transform | —           | Pass 6 rebuild (FLAGS_auto_recompute) |
 | dce               | transform | dead_code   | opt-in dead-op elimination |
 """
@@ -71,10 +72,12 @@ TRANSFORM = "transform"
 # check_program) and the full static-analysis suite the lint CLI drives
 VERIFY_PASSES = ("schema", "dataflow", "lowerability", "shape_replay",
                  "liveness")
-# sharding_check is a silent no-op without a mesh option, so the full
-# lint pipeline can always include it
+# sharding_check is a silent no-op without a mesh option, and
+# numerics_check is one linear walk on a findings-free program, so the
+# full lint pipeline can always include both
 ALL_ANALYSIS_PASSES = VERIFY_PASSES + ("dtype_shape_check", "donation_race",
-                                       "dead_code", "sharding_check")
+                                       "dead_code", "sharding_check",
+                                       "numerics_check")
 
 class PassVerificationError(ProgramVerificationError):
     """A transform pass broke the pipeline invariant: ``verify_program``

@@ -296,14 +296,23 @@ def analyze_block_io(block, feed_names: set, fetch_names) -> dict:
 
 
 def make_step_fn(block, io: dict, fetch_names, mesh=None,
-                 nan_check_meta=None, gemm_blocks=None):
+                 nan_check_meta=None, gemm_blocks=None,
+                 num_witness_meta=None):
     """The traced step body shared by all execution paths.
 
     ``nan_check_meta``: pass a list to enable FLAGS_check_nan_inf — at trace
     time it fills with one label per float op output and the step returns an
     extra bool vector (aligned with the labels) that the executor inspects
     host-side (reference operator.cc fast_check_nan_inf, but one fused
-    check vector per step instead of a sync per op)."""
+    check vector per step instead of a sync per op).
+
+    ``num_witness_meta``: pass a list to enable FLAGS_numerics_witness — at
+    trace time it fills with one var name per float op output and the step
+    returns an extra ``(N, 4)`` [absmax, min, max, nonfinite-count] stats
+    array as the LAST tuple element (after the nan-check vector when both
+    are on); ``strip_witness_stats`` peels it off and merges it into
+    ``monitor.numwitness``. One fused device->host stats transfer per step,
+    same batching idiom as the nan checks."""
 
     def step_fn(feed_vals, donated_vals, ro_vals, rng_key):
         env: Dict[str, Any] = {}
@@ -311,21 +320,46 @@ def make_step_fn(block, io: dict, fetch_names, mesh=None,
         env.update(zip(io["donated"], donated_vals))
         env.update(zip(io["ro"], ro_vals))
         checks = None if nan_check_meta is None else []
+        taps = None if num_witness_meta is None else []
         ctx = LowerCtx(base_key=rng_key, mesh=mesh,
                        program=getattr(block, "program", None),
-                       nan_checks=checks, gemm_blocks=gemm_blocks)
+                       nan_checks=checks, gemm_blocks=gemm_blocks,
+                       num_taps=taps)
         lower_block(block, env, ctx)
         fetches = [env[n] for n in fetch_names]
         new_state = [env[n] for n in io["state_out"]]
+        result = [fetches, new_state]
         if checks is not None:
             nan_check_meta.clear()
             nan_check_meta.extend(label for label, _ in checks)
-            flags_vec = (jnp.stack([ok for _, ok in checks])
-                         if checks else jnp.ones((0,), bool))
-            return fetches, new_state, flags_vec
-        return fetches, new_state
+            result.append(jnp.stack([ok for _, ok in checks])
+                          if checks else jnp.ones((0,), bool))
+        if taps is not None:
+            num_witness_meta.clear()
+            num_witness_meta.extend(name for name, _ in taps)
+            result.append(jnp.stack([s for _, s in taps])
+                          if taps else jnp.zeros((0, 4), jnp.float32))
+        return tuple(result)
 
     return step_fn
+
+
+def strip_witness_stats(step, result, to_host=np.asarray, path="run"):
+    """FLAGS_numerics_witness protocol: a witness-instrumented step (one
+    with ``step.num_witness_meta`` set) returns its ``(N, 4)`` per-var
+    stats array as the LAST tuple element. Peel it off and merge it into
+    ``monitor.numwitness`` BEFORE ``unpack_step_result`` runs — recording
+    first means the witness attribution (``numwitness.first_offender``)
+    is already fresh when a tripped nan check escalates or skips, which
+    is what lets the skip counter and the flight recorder name the
+    first offending var (docs/OBSERVABILITY.md)."""
+    meta = getattr(step, "num_witness_meta", None)
+    if meta is None:
+        return result
+    from .monitor import numwitness
+
+    numwitness.record_step(list(meta), to_host(result[-1]), path=path)
+    return result[:-1]
 
 
 def unpack_step_result(step, result, scope, to_host=np.asarray, *,
@@ -838,6 +872,7 @@ class Executor:
                     # Only under FLAGS_step_timeout_s, which opts into
                     # deadline-over-overlap
                     jax.block_until_ready(result)
+        result = strip_witness_stats(step, result, path="run")
         fetches, new_state = unpack_step_result(step, result, scope,
                                                 path="run", exe=self,
                                                 rollback=rollback)
@@ -1284,7 +1319,7 @@ class Executor:
             tuning_program if tuning_program is not None else program, feed)
         key = (self._program_fingerprint(program), feed_sig,
                tuple(fetch_names), scope._serial, flag("check_nan_inf"),
-               xla_opts, gemm_blocks)
+               flag("numerics_witness"), xla_opts, gemm_blocks)
         # the whole lookup-or-build runs under the executor lock: two
         # threads racing the same key must share ONE step (and one monitor
         # compile record); _compile only builds the jit wrapper — the
@@ -1302,13 +1337,15 @@ class Executor:
                                      scope, xla_opts=opts,
                                      gemm_blocks=gemm_blocks)
             step.program = program
-            if not flag("check_nan_inf"):
+            if not flag("check_nan_inf") and not flag("numerics_witness"):
                 # nan-checked steps are NOT disk-cached: their per-op
                 # provenance labels (nan_check_meta) are filled at trace
                 # time, which a loaded executable skips — a tripped
                 # check would lose the op attribution that is the
                 # flag's whole point. (The chained path's coarse
                 # host-side check carries no meta, so it stays cached.)
+                # Witness-instrumented steps skip it for the same reason:
+                # num_witness_meta's var names are filled at trace time.
                 step._aot_cache_parts = ("run", program,
                                          tuple(fetch_names), xla_opts,
                                          gemm_blocks)
@@ -1336,15 +1373,23 @@ class Executor:
         block = program.global_block
         io = analyze_block_io(block, feed_names, fetch_names)
         meta = [] if flag("check_nan_inf") else None
-        step_fn = pick_step_fn(program)(block, io, fetch_names,
-                                        nan_check_meta=meta,
-                                        gemm_blocks=gemm_blocks)
+        maker = pick_step_fn(program)
+        # numerics witness: make_step_fn path only — the microbatched
+        # pipeline body runs under lax.scan, where per-op taps would be
+        # tracer escapes (same reason its nan checks are the coarse kind)
+        wmeta = ([] if flag("numerics_witness") and maker is make_step_fn
+                 else None)
+        kwargs = dict(nan_check_meta=meta, gemm_blocks=gemm_blocks)
+        if wmeta is not None:
+            kwargs["num_witness_meta"] = wmeta
+        step_fn = maker(block, io, fetch_names, **kwargs)
         jitted = jax.jit(step_fn, donate_argnums=(1,),
                          compiler_options=xla_opts or None)
         step = _CompiledStep(jitted, io["feed_order"], io["donated"],
                              io["ro"], io["state_out"], tuple(fetch_names))
         step.kept_names = [n for n in io["ro"] if n in io["state_out"]]
         step.nan_check_meta = meta  # filled lazily at first trace
+        step.num_witness_meta = wmeta  # ditto
         return step
 
     def _ensure_executable(self, step: _CompiledStep, args):

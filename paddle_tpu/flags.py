@@ -41,6 +41,14 @@ _DEFS: Dict[str, tuple] = {
                      "the static PT800 lock-order graph by "
                      "tools/load_check.py --fleet-chaos. Off: the "
                      "factories return plain threading primitives"),
+    "numerics_witness": (bool, False,
+                         "compile per-var numeric range taps into every "
+                         "step (monitor.numwitness): jitted abs-max/min/"
+                         "max + nonfinite counts per float op output, "
+                         "merged host-side and cross-checked against the "
+                         "numerics_check pass's static intervals by "
+                         "tools/lint_numerics.py --witness. Off: steps "
+                         "trace without taps (no hot-path cost)"),
     "log_compiles": (bool, False,
                      "log every executor compile (INFO) and recompile "
                      "(WARNING, with the changed cache-key component and "

@@ -73,7 +73,8 @@ class LowerCtx:
     """Context passed to every op lowering rule."""
 
     def __init__(self, base_key=None, uid: int = 0, mesh=None, axis_env=None,
-                 program=None, nan_checks=None, gemm_blocks=None):
+                 program=None, nan_checks=None, gemm_blocks=None,
+                 num_taps=None):
         self.base_key = base_key
         self.uid = uid
         self.mesh = mesh          # jax.sharding.Mesh when lowering under shard_map
@@ -83,6 +84,12 @@ class LowerCtx:
         # per float op output during the trace; the executor fetches the
         # bools and raises with the label on the first non-finite one
         self.nan_checks = nan_checks
+        # FLAGS_numerics_witness: list collecting (var name, stats-vector
+        # [absmax, min, max, nonfinite-count]) per float op output; the
+        # executor stacks them into one (N, 4) fetch per step
+        # (monitor.numwitness). Shares nan_checks' tracer-escape rule:
+        # sub-block lowerings must null it.
+        self.num_taps = num_taps
         # autotuner-chosen fused-GEMM block sizes for THIS compile, bound
         # at step-fn build time (the same values that sit in the compile
         # cache key) — a shared per-Program stamp read lazily at trace
@@ -100,7 +107,8 @@ class LowerCtx:
 
     def with_uid(self, uid: int) -> "LowerCtx":
         return LowerCtx(self.base_key, uid, self.mesh, self.axis_env,
-                        self.program, self.nan_checks, self.gemm_blocks)
+                        self.program, self.nan_checks, self.gemm_blocks,
+                        self.num_taps)
 
 
 def _gather_inputs(op, env: Dict[str, Any]) -> Dict[str, List[Any]]:
@@ -149,6 +157,21 @@ def lower_op(op, env: Dict[str, Any], ctx: LowerCtx) -> None:
                 ctx.nan_checks.append(
                     (f"op '{op.type}' output '{name}'{_op_site(op)}",
                      jnp.isfinite(v).all()))
+    if ctx.num_taps is not None:
+        for name in op.output_arg_names:
+            v = env.get(name)
+            if v is not None and hasattr(v, "dtype") and \
+                    jnp.issubdtype(jnp.result_type(v), jnp.inexact) and \
+                    getattr(v, "size", 0):
+                # [absmax, min, max, nonfinite-count] with nonfinite lanes
+                # masked out of the range stats (numwitness module doc)
+                vf = jnp.ravel(v).astype(jnp.float32)
+                finite = jnp.isfinite(vf)
+                ctx.num_taps.append((name, jnp.stack([
+                    jnp.max(jnp.where(finite, jnp.abs(vf), 0.0)),
+                    jnp.min(jnp.where(finite, vf, jnp.inf)),
+                    jnp.max(jnp.where(finite, vf, -jnp.inf)),
+                    jnp.sum(~finite).astype(jnp.float32)])))
 
 
 class _OpLoweringError(RuntimeError):
@@ -169,6 +192,7 @@ def _lower_op_inner(op, env: Dict[str, Any], ctx: LowerCtx) -> None:
         if op_ctx.program is None:
             op_ctx.program = op.block.program
         op_ctx.nan_checks = None
+        op_ctx.num_taps = None  # same tracer-escape rule as nan_checks
         opdef.lower(op_ctx, op, env)
         return
     ins = _gather_inputs(op, env)
@@ -228,6 +252,7 @@ def _lower_generic_grad(op, env: Dict[str, Any], ctx: LowerCtx) -> None:
             # inside scan/while bodies — their inner ops must not append to
             # the top-level nan-check list (tracer escape)
             op_ctx.nan_checks = None
+            op_ctx.num_taps = None
             fwd_def.grad_lower(op_ctx, op, env)
             return
         # NOTE: no AMP cast here — a custom grad rule owns its precision.

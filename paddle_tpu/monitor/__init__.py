@@ -42,6 +42,9 @@ from .hooks import (CompileRecord, Hook, StepRecord, add_hook, clear_hooks,
 from .lockwitness import (make_condition, make_lock, make_rlock,
                           reset_witness, witness_cycles, witness_edges,
                           witness_enabled, witness_report)
+from .numwitness import (containment_violations, first_offender,
+                         numerics_witness_enabled, numerics_witness_report,
+                         numerics_witness_vars, reset_numerics_witness)
 from .recompile import RecompileTracker, build_site, get_tracker
 from .registry import (DEFAULT_TIME_BUCKETS, Counter, Gauge, Histogram,
                        MetricFamily, MetricsRegistry, counter, gauge,
@@ -61,6 +64,9 @@ __all__ = [
     "recompile_count", "snapshot", "reset", "get_tracker", "build_site",
     "make_lock", "make_rlock", "make_condition", "witness_enabled",
     "witness_report", "witness_edges", "witness_cycles", "reset_witness",
+    "numerics_witness_enabled", "numerics_witness_report",
+    "numerics_witness_vars", "reset_numerics_witness", "first_offender",
+    "containment_violations",
 ]
 
 _step_counter = itertools.count()

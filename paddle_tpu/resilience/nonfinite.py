@@ -36,11 +36,28 @@ from __future__ import annotations
 import logging
 
 __all__ = ["policy", "rollback_active", "record_skip", "record_clean",
-           "POLICIES"]
+           "witness_attribution", "POLICIES"]
 
 logger = logging.getLogger("paddle_tpu.resilience")
 
 POLICIES = ("raise", "skip", "zero_grad")
+
+
+def witness_attribution() -> str:
+    """First-offending-var attribution from the numerics witness, as a
+    message suffix. The executor records the step's witness stats BEFORE
+    the nan-check protocol runs (executor.strip_witness_stats), so when a
+    skip or escalation fires here the witness already knows WHICH var went
+    non-finite first in program order — finer-grained than the nan-check
+    label when several ops tripped in one step. Empty string when the
+    witness is off or the last step was clean."""
+    from ..monitor import numwitness
+
+    offender = numwitness.first_offender()
+    if offender is None:
+        return ""
+    return (f" [numerics witness: first non-finite var this step was "
+            f"'{offender}']")
 
 
 def policy() -> str:
@@ -74,7 +91,14 @@ def record_skip(path: str, label: str, exe=None) -> None:
     from .. import monitor as _monitor
     from ..flags import flag
 
+    from .. import trace as _trace
+
     pol = policy()
+    attribution = witness_attribution()
+    _trace.record_incident(
+        "nonfinite_step",
+        detail=f"path '{path}': non-finite value in {label} "
+               f"(policy {pol}){attribution}")
     if _monitor.enabled():
         _monitor.counter(
             "steps_skipped_nonfinite_total",
@@ -87,13 +111,14 @@ def record_skip(path: str, label: str, exe=None) -> None:
             raise FloatingPointError(
                 f"FLAGS_nan_inf_policy=skip escalated to raise: "
                 f"{exe._nonfinite_consec} consecutive non-finite steps "
-                f"(limit {limit}; last: non-finite value in {label}). "
-                f"Persistent non-finiteness is a model/data bug, not "
-                f"transient noise — state was rolled back to pre-step "
-                f"values.")
+                f"(limit {limit}; last: non-finite value in "
+                f"{label}).{attribution} Persistent non-finiteness is a "
+                f"model/data bug, not transient noise — state was rolled "
+                f"back to pre-step values.")
     logger.warning(
         "nan_inf_policy=%s: dropping step on path '%s' (non-finite value "
-        "in %s); state rolled back to pre-step values", pol, path, label)
+        "in %s)%s; state rolled back to pre-step values", pol, path, label,
+        attribution)
 
 
 def record_clean(exe) -> None:

@@ -1,0 +1,245 @@
+"""paddle_tpu.monitor.numwitness — the runtime half of the PT900
+numerics gate (FLAGS_numerics_witness). Record/merge semantics, the
+tolerance-free containment cross-check against the static intervals,
+the disabled-is-a-no-op hot-path contract, and the first-offender
+attribution feeding FLAGS_nan_inf_policy escalations and the flight
+recorder (ISSUE 17 satellite)."""
+import logging
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.unique_name as un
+from paddle_tpu.analysis.numerics import static_intervals
+from paddle_tpu.monitor import numwitness
+
+
+@pytest.fixture
+def flags_guard():
+    from paddle_tpu import flags as F
+
+    saved = dict(F._overrides)
+    yield fluid.set_flags
+    F._overrides.clear()
+    F._overrides.update(saved)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_witness():
+    numwitness.reset_numerics_witness()
+    yield
+    numwitness.reset_numerics_witness()
+
+
+# ---------------------------------------------------------------------------
+# record/merge semantics (pure host logic)
+# ---------------------------------------------------------------------------
+
+def test_record_step_merges_ranges_across_steps():
+    numwitness.record_step(["a", "b"],
+                           [[2.0, -2.0, 1.0, 0.0],
+                            [5.0, 0.5, 5.0, 0.0]])
+    numwitness.record_step(["a", "b"],
+                           [[3.0, -1.0, 3.0, 0.0],
+                            [4.0, 0.1, 4.0, 2.0]])
+    v = numwitness.numerics_witness_vars()
+    assert v["a"] == {"absmax": 3.0, "min": -2.0, "max": 3.0,
+                      "nonfinite": 0, "steps": 2}
+    assert v["b"] == {"absmax": 5.0, "min": 0.1, "max": 5.0,
+                      "nonfinite": 2, "steps": 2}
+    rep = numwitness.numerics_witness_report()
+    assert rep["nonfinite_total"] == 2
+
+
+def test_all_nonfinite_var_reports_no_finite_range():
+    """min/max fold nonfinite lanes away: a var that was ALL nan keeps
+    min=+inf/max=-inf internally and serializes them as None."""
+    numwitness.record_step(["x"], [[0.0, np.inf, -np.inf, 4.0]])
+    v = numwitness.numerics_witness_vars()["x"]
+    assert v["min"] is None and v["max"] is None
+    assert v["nonfinite"] == 4
+
+
+def test_first_offender_is_per_step_not_cumulative():
+    numwitness.record_step(["a", "b", "c"],
+                           [[1.0, 0.0, 1.0, 0.0],
+                            [1.0, 0.0, 1.0, 3.0],
+                            [1.0, 0.0, 1.0, 1.0]])
+    assert numwitness.first_offender() == "b"   # first in program order
+    numwitness.record_step(["a", "b", "c"],
+                           [[1.0, 0.0, 1.0, 0.0],
+                            [1.0, 0.0, 1.0, 0.0],
+                            [1.0, 0.0, 1.0, 0.0]])
+    assert numwitness.first_offender() is None  # last step was clean
+
+
+def test_containment_violations_logic():
+    static = {"a": (-1.0, 1.0), "b": (0.0, 10.0), "c": (0.0, 1.0)}
+    observed = {
+        "a": {"absmax": 0.9, "min": -0.9, "max": 0.9,
+              "nonfinite": 0, "steps": 1},             # inside
+        "b": {"absmax": 11.0, "min": -0.5, "max": 11.0,
+              "nonfinite": 0, "steps": 1},             # both sides escape
+        "d": {"absmax": 99.0, "min": -99.0, "max": 99.0,
+              "nonfinite": 0, "steps": 1},             # no static side
+        # c: never witnessed -> skipped
+    }
+    v = numwitness.containment_violations(static, observed)
+    assert [(x["var"], x["bound"]) for x in v] == [("b", "lo"), ("b", "hi")]
+    assert "observed min -0.5 < static lower bound 0" in v[0]["detail"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the executor's witness taps
+# ---------------------------------------------------------------------------
+
+def _bounded_net():
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            t = fluid.layers.tanh(x)
+            s = fluid.layers.sigmoid(t)
+            out = fluid.layers.mean(fluid.layers.scale(s, scale=2.0))
+    return main, startup, out
+
+
+def _run(main, startup, fetch, steps=2):
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(steps):
+            exe.run(main, feed={"x": rng.randn(4, 8).astype(np.float32)},
+                    fetch_list=[fetch])
+    return exe
+
+
+def test_witness_observes_vars_and_contains_them(flags_guard):
+    main, startup, out = _bounded_net()
+    flags_guard({"FLAGS_numerics_witness": 1})
+    _run(main, startup, out.name)
+    observed = numwitness.numerics_witness_vars()
+    assert observed, "witness on: float op outputs must be observed"
+    static = static_intervals(main, fetch_names=[out.name])
+    checked = set(static) & set(observed)
+    assert checked, "bounded vars (tanh/sigmoid/...) must be witnessed"
+    violations = numwitness.containment_violations(static, observed)
+    assert violations == [], (
+        "tolerance-free containment: any escape is an analysis "
+        f"soundness bug — {violations}")
+
+
+def test_witness_disabled_is_a_hot_path_no_op(flags_guard):
+    """Flag off (the default): no tap is traced, nothing recorded, and
+    the compiled step carries no witness metadata."""
+    main, startup, out = _bounded_net()
+    exe = _run(main, startup, out.name)
+    assert numwitness.numerics_witness_vars() == {}
+    step = next(iter(exe._cache.values()))
+    assert step.num_witness_meta is None
+
+
+def test_witness_flag_flips_get_separate_compiles(flags_guard):
+    """The flag is part of the compile cache key: flipping it mid-session
+    must not serve a step traced without taps (or vice versa)."""
+    main, startup, out = _bounded_net()
+    exe = _run(main, startup, out.name)
+    flags_guard({"FLAGS_numerics_witness": 1})
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.zeros((4, 8), np.float32)},
+                fetch_list=[out.name])
+    metas = [s.num_witness_meta for s in exe._cache.values()]
+    assert None in metas and any(m is not None for m in metas)
+    assert numwitness.numerics_witness_vars()
+
+
+# ---------------------------------------------------------------------------
+# attribution: the witness names the first offender for the nan/inf
+# machinery (resilience.nonfinite + the flight recorder)
+# ---------------------------------------------------------------------------
+
+def _nan_net():
+    """First non-finite producer in program order is the log of a
+    negative constant."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            c = fluid.layers.fill_constant(shape=[4], dtype="float32",
+                                           value=-1.0)
+            bad = fluid.layers.log(c)                 # nan, first
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            out = fluid.layers.mean(fluid.layers.elementwise_add(x, bad))
+    return main, startup, bad, out
+
+
+def test_escalation_message_names_the_first_offender(flags_guard, caplog):
+    main, startup, bad, out = _nan_net()
+    flags_guard({"FLAGS_numerics_witness": 1, "FLAGS_check_nan_inf": 1,
+                 "FLAGS_nan_inf_policy": "skip",
+                 "FLAGS_nan_inf_max_consecutive_skips": 2})
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": np.zeros((1, 4), np.float32)}
+    incidents_before = len([i for i in fluid.trace.incidents()
+                            if i.get("kind") == "nonfinite_step"])
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with caplog.at_level(logging.WARNING, "paddle_tpu.resilience"):
+            exe.run(main, feed=feed, fetch_list=[out.name])   # skip #1
+            with pytest.raises(FloatingPointError) as ei:
+                exe.run(main, feed=feed, fetch_list=[out.name])  # escalate
+    attribution = f"first non-finite var this step was '{bad.name}'"
+    assert attribution in str(ei.value)
+    assert any(attribution in r.getMessage() for r in caplog.records)
+    # both dropped steps left a flight-recorder incident carrying the
+    # same attribution
+    incidents = [i for i in fluid.trace.incidents()
+                 if i.get("kind") == "nonfinite_step"]
+    assert len(incidents) == incidents_before + 2
+    assert all(attribution in i.get("detail", "") for i in incidents[-2:])
+
+
+def test_attribution_is_empty_without_the_witness(flags_guard):
+    """The nan-check machinery works unchanged with the witness off —
+    the suffix is simply absent (no stale offender leaks in)."""
+    from paddle_tpu.resilience.nonfinite import witness_attribution
+
+    main, startup, _bad, out = _nan_net()
+    flags_guard({"FLAGS_check_nan_inf": 1, "FLAGS_nan_inf_policy": "skip",
+                 "FLAGS_nan_inf_max_consecutive_skips": 0})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.zeros((1, 4), np.float32)},
+                fetch_list=[out.name])
+    assert witness_attribution() == ""
+
+
+def test_observed_absmax_is_the_calibration_dict(flags_guard):
+    """numerics_witness_vars()['absmax'] feeds analyze_numerics as
+    calibration — the PT906 feedback loop lint_numerics --witness runs."""
+    from paddle_tpu.analysis.numerics import analyze_numerics
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            a = fluid.layers.data("a", shape=[8, 8], dtype="float32")
+            b = fluid.layers.data("b", shape=[8, 8], dtype="float32")
+            out = fluid.layers.matmul(a, b)
+    flags_guard({"FLAGS_numerics_witness": 1})
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(1)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"a": rng.randn(8, 8).astype(np.float32),
+                            "b": rng.randn(8, 8).astype(np.float32)},
+                fetch_list=[out.name])
+    calib = {n: o["absmax"]
+             for n, o in numwitness.numerics_witness_vars().items()}
+    assert calib
+    rep = analyze_numerics(main, fetch_names=[out.name], calibration=calib)
+    (site,) = rep.quant_sites
+    assert site["calibrated_absmax"], "observed abs-max reaches the site"
+    assert set(site["calibrated_absmax"]) <= {"a", "b", out.name}

@@ -63,8 +63,9 @@ def test_every_code_is_documented_and_tested():
     # the CODES table is the single source of truth; this file (or
     # test_pass_manager.py, which owns the PT70x-PT72x pass-manager
     # families, test_sharding_check.py, which owns PT73x,
-    # test_epilogue_fusion.py, which owns PT75x, or
+    # test_epilogue_fusion.py, which owns PT75x,
     # test_concurrency_lint.py, which owns the source-level PT80x
+    # family, or test_numerics.py, which owns the PT90x numerics
     # family) must cover every code
     import io
     import os
@@ -79,7 +80,9 @@ def test_every_code_is_documented_and_tested():
                   os.path.join(os.path.dirname(here),
                                "test_epilogue_fusion.py"),
                   os.path.join(os.path.dirname(here),
-                               "test_concurrency_lint.py")):
+                               "test_concurrency_lint.py"),
+                  os.path.join(os.path.dirname(here),
+                               "test_numerics.py")):
         with io.open(fname, "r", encoding="utf-8") as f:
             me += f.read()
     assert len(CODES) >= 10

@@ -1,0 +1,180 @@
+"""paddle_tpu.ops.quant_ops — forward parity against the reference
+fake_quantize_op.h formulas and the straight-through-estimator backward
+through append_backward (ISSUE 17 satellite: the numerics analysis
+polices these ops' IR contract, this file proves their arithmetic)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.unique_name as un
+from paddle_tpu.backward import append_backward
+
+RNG = np.random.RandomState(11)
+
+
+def _ref_quant(v, scale, bits=8):
+    """ClipAndFakeQuantFunctor: round(clip(v/s, -1, 1) * qmax) / qmax * s."""
+    qmax = float(2 ** (bits - 1) - 1)
+    s = max(float(scale), 1e-8)
+    return (np.round(np.clip(v / s, -1.0, 1.0) * qmax) / qmax * s).astype(
+        np.float32)
+
+
+def _run_op(op_type, inputs, attrs, out_names, input_vars=(),
+            extra_vars=()):
+    """Append one raw quant op and run it, returning the fetches."""
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            blk = main.global_block
+            feed = {}
+            for name, val in input_vars:
+                fluid.layers.data(name, shape=list(val.shape[1:]) or [1],
+                                  dtype="float32")
+                feed[name] = val
+            for name, val in extra_vars:
+                blk.create_var(name=name, shape=val.shape, dtype="float32",
+                               persistable=True)
+                feed[name] = val
+            for name in out_names:
+                blk.create_var(name=name, dtype="float32")
+            blk.append_op(op_type, inputs=inputs,
+                          outputs=dict(zip(("Out", "OutScale"),
+                                           [[n] for n in out_names])),
+                          attrs=attrs)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            return exe.run(main, feed=feed, fetch_list=out_names)
+
+
+# ---------------------------------------------------------------------------
+# forward parity vs the reference formulas
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_abs_max_forward_matches_reference(bits):
+    v = (RNG.randn(4, 6) * 3).astype(np.float32)
+    out, out_scale = _run_op(
+        "fake_quantize_dequantize_abs_max",
+        inputs={"X": ["x"]}, attrs={"bit_length": bits},
+        out_names=["q", "s"], input_vars=[("x", v)])
+    scale = np.abs(v).max()
+    np.testing.assert_allclose(np.asarray(out_scale).reshape(-1),
+                               [scale], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out), _ref_quant(v, scale, bits),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_abs_max_quantization_error_bounded_by_resolution():
+    """|q - v| <= scale / qmax / 2 everywhere inside the clip range —
+    the 8-bit resolution guarantee the QAT accuracy argument rests on."""
+    v = (RNG.randn(32, 16)).astype(np.float32)
+    (out, _s) = _run_op(
+        "fake_quantize_dequantize_abs_max",
+        inputs={"X": ["x"]}, attrs={"bit_length": 8},
+        out_names=["q", "s"], input_vars=[("x", v)])
+    scale = np.abs(v).max()
+    err = np.abs(np.asarray(out) - v)
+    assert err.max() <= scale / 127.0 / 2.0 + 1e-6
+
+
+def test_moving_average_training_updates_the_scale():
+    """Training mode: scale = rate * in_scale + (1 - rate) * batch_absmax,
+    and the output quantizes against the UPDATED scale."""
+    v = (RNG.randn(5, 7) * 2).astype(np.float32)
+    in_scale = np.array([0.5], np.float32)
+    rate = 0.9
+    out, out_scale = _run_op(
+        "fake_quantize_dequantize_moving_average_abs_max",
+        inputs={"X": ["x"], "InScale": ["scale_in"]},
+        attrs={"bit_length": 8, "moving_rate": rate, "is_test": False},
+        out_names=["q", "s"], input_vars=[("x", v)],
+        extra_vars=[("scale_in", in_scale)])
+    expect_scale = rate * in_scale[0] + (1 - rate) * np.abs(v).max()
+    np.testing.assert_allclose(np.asarray(out_scale).reshape(-1),
+                               [expect_scale], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out),
+                               _ref_quant(v, expect_scale),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_moving_average_test_mode_freezes_the_scale():
+    """is_test: the batch abs-max is ignored — inference quantizes
+    against the calibrated scale exactly (values beyond it saturate)."""
+    v = (RNG.randn(5, 7) * 4).astype(np.float32)
+    in_scale = np.array([1.25], np.float32)
+    out, out_scale = _run_op(
+        "fake_quantize_dequantize_moving_average_abs_max",
+        inputs={"X": ["x"], "InScale": ["scale_in"]},
+        attrs={"bit_length": 8, "moving_rate": 0.9, "is_test": True},
+        out_names=["q", "s"], input_vars=[("x", v)],
+        extra_vars=[("scale_in", in_scale)])
+    np.testing.assert_allclose(np.asarray(out_scale).reshape(-1),
+                               in_scale, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out),
+                               _ref_quant(v, in_scale[0]),
+                               rtol=1e-6, atol=1e-7)
+    assert np.abs(np.asarray(out)).max() <= in_scale[0] + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# STE backward through append_backward
+# ---------------------------------------------------------------------------
+
+def _ste_program(op_type, extra_inputs=None, attrs=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6], dtype="float32",
+                              stop_gradient=False)
+        blk = main.global_block
+        q = blk.create_var(name="q", dtype="float32")
+        s = blk.create_var(name="s", dtype="float32")
+        inputs = {"X": ["x"]}
+        for slot, (name, val) in (extra_inputs or {}).items():
+            blk.create_var(name=name, shape=val.shape, dtype="float32",
+                           persistable=True)
+            inputs[slot] = [name]
+        blk.append_op(op_type, inputs=inputs,
+                      outputs={"Out": ["q"], "OutScale": ["s"]},
+                      attrs=attrs or {})
+        loss = fluid.layers.mean(fluid.layers.scale(q, scale=3.0))
+        grads = append_backward(loss)
+    return main, startup, x, loss, grads
+
+
+@pytest.mark.parametrize("op_type,extra", [
+    ("fake_quantize_dequantize_abs_max", None),
+    ("fake_quantize_dequantize_moving_average_abs_max",
+     {"InScale": ("scale_in", np.array([1.0], np.float32))}),
+])
+def test_straight_through_gradient_via_append_backward(op_type, extra):
+    """The STE contract: d(loss)/dx passes through the staircase as
+    identity — here d(mean(3 q))/dx = 3/n exactly, even though the true
+    staircase derivative is 0 almost everywhere."""
+    with un.guard():
+        main, startup, x, loss, _grads = _ste_program(
+            op_type, extra_inputs=extra)
+    gname = f"{x.name}@GRAD"
+    assert main.global_block.has_var(gname), (
+        "append_backward must reach through the fake-quant op back to x")
+    v = (RNG.randn(4, 6) * 2).astype(np.float32)
+    feed = {"x": v}
+    for _slot, (name, val) in (extra or {}).items():
+        feed[name] = val
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (g,) = exe.run(main, feed=feed, fetch_list=[gname])
+    np.testing.assert_allclose(np.asarray(g),
+                               np.full_like(v, 3.0 / v.size), rtol=1e-6)
+
+
+def test_scale_output_carries_no_gradient():
+    """OutScale is declared no_grad: the backward must not try to route a
+    gradient into the scale computation."""
+    with un.guard():
+        main, _startup, x, _loss, _grads = _ste_program(
+            "fake_quantize_dequantize_abs_max")
+    assert not main.global_block.has_var("s@GRAD")
+    assert main.global_block.has_var(f"{x.name}@GRAD")
