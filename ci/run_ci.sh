@@ -181,10 +181,17 @@ echo "   behind the load-aware router, one SIGTERMed mid-burst — the fleet"
 echo "   sheds nothing it admitted, every request reaches exactly one outcome"
 echo "   fleet-wide, p50/p99 end-to-end latency recorded; a cold replica"
 echo "   restarted with the warm-start AOT executable cache must report"
-echo "   measurably faster time-to-ready than its cold baseline)"
+echo "   measurably faster time-to-ready than its cold baseline. Then the"
+echo "   telemetry-plane leg: fleet p50/p99 assembled from SCRAPED per-"
+echo "   replica /metrics via the exact histogram merge and cross-checked"
+echo "   against the router ledger, SLO burn state flips to burning under"
+echo "   injected stalled batches and recovers, the per-tenant ledger"
+echo "   reconciles exactly, exported exemplar trace ids resolve to"
+echo "   recorded traces, and a corrupt-/metrics target degrades typed"
+echo "   (stale-marked, counted) with zero aggregator crashes)"
 JAX_PLATFORMS=cpu python tools/load_check.py --ci --fleet \
   --log-dir "${CI_ARTIFACT_DIR:-.}" \
-  --json "${CI_ARTIFACT_DIR:-.}/ci_fleet_report.json" | tail -8
+  --json "${CI_ARTIFACT_DIR:-.}/ci_fleet_report.json" | tail -12
 echo "== fleet negative control (router drain honoring + unadmitted retry"
 echo "   disabled: the kill scenario must FAIL the gate)"
 FLEET_NEG_LOG="${CI_ARTIFACT_DIR:-.}/ci_fleet_negative.log"

@@ -253,6 +253,38 @@ _DEFS: Dict[str, tuple] = {
                                   "admission (typed Overloaded, reason "
                                   "poison_quarantine) instead of failing "
                                   "another batch. Oldest evicted"),
+    "serving_slo_latency_s": (str, "batch:30,standard:1.0,interactive:0.25",
+                              "per-priority-class latency objective for "
+                              "the SLO burn-rate tracker (serving/slo.py; "
+                              "docs/SERVING.md 'SLO burn rate'): "
+                              "'class:seconds' pairs, comma-separated. A "
+                              "completed request slower than its class "
+                              "target, or any non-completed terminal "
+                              "outcome, consumes error budget"),
+    "serving_slo_error_budget": (float, 0.01,
+                                 "allowed bad-request fraction of the SLO "
+                                 "objective; burn rate = observed bad "
+                                 "fraction / this budget (1.0 = burning "
+                                 "exactly at budget)"),
+    "serving_slo_fast_window_s": (float, 60.0,
+                                  "fast burn-rate window in seconds (the "
+                                  "page-now signal of the multi-window "
+                                  "burn alert)"),
+    "serving_slo_slow_window_s": (float, 600.0,
+                                  "slow burn-rate window in seconds (the "
+                                  "sustained-burn confirmation window)"),
+    # fleet telemetry plane (serving/fleet/telemetry.py;
+    # docs/OBSERVABILITY.md 'Fleet telemetry plane')
+    "fleet_telemetry": (bool, False,
+                        "fleet telemetry plane: when on, request-latency "
+                        "observations carry trace-id exemplars into the "
+                        "JSON /metrics form and FleetAggregator.start() "
+                        "runs its scrape thread. Off (default) is a "
+                        "hot-path no-op: no exemplar allocation, no "
+                        "scrape thread"),
+    "fleet_scrape_interval_s": (float, 1.0,
+                                "FleetAggregator scrape interval in "
+                                "seconds (per-replica GET /metrics)"),
     "auto_recompute": (bool, False,
                        "automatic rematerialisation: on Executor.run / "
                        "run_chained / CompiledProgram, training programs "

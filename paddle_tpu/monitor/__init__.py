@@ -45,10 +45,15 @@ from .lockwitness import (make_condition, make_lock, make_rlock,
 from .numwitness import (containment_violations, first_offender,
                          numerics_witness_enabled, numerics_witness_report,
                          numerics_witness_vars, reset_numerics_witness)
+from .promtext import (ParsedFamily, PromParseError,
+                       histogram_snapshot_from_samples,
+                       parse_prometheus_text)
 from .recompile import RecompileTracker, build_site, get_tracker
 from .registry import (DEFAULT_TIME_BUCKETS, Counter, Gauge, Histogram,
                        MetricFamily, MetricsRegistry, counter, gauge,
-                       get_registry, histogram, metric_value)
+                       get_registry, histogram,
+                       merge_histogram_snapshots, metric_value,
+                       snapshot_quantile)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricFamily", "MetricsRegistry",
@@ -67,6 +72,12 @@ __all__ = [
     "numerics_witness_enabled", "numerics_witness_report",
     "numerics_witness_vars", "reset_numerics_witness", "first_offender",
     "containment_violations",
+    # telemetry plane: exact histogram-snapshot algebra + the
+    # scrape-side Prometheus text parser (docs/OBSERVABILITY.md
+    # "Fleet telemetry plane")
+    "merge_histogram_snapshots", "snapshot_quantile",
+    "parse_prometheus_text", "histogram_snapshot_from_samples",
+    "ParsedFamily", "PromParseError", "telemetry_enabled",
 ]
 
 _step_counter = itertools.count()
@@ -77,6 +88,16 @@ def enabled() -> bool:
     from ..flags import flag
 
     return bool(flag("monitor"))
+
+
+def telemetry_enabled() -> bool:
+    """Fleet telemetry plane master switch (``FLAGS_fleet_telemetry``,
+    default OFF): gates the aggregator scrape thread and trace-exemplar
+    capture — off must stay a hot-path no-op
+    (docs/OBSERVABILITY.md "Fleet telemetry plane")."""
+    from ..flags import flag
+
+    return bool(flag("fleet_telemetry"))
 
 
 # -- executor instrumentation entry points ---------------------------------

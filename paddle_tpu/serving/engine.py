@@ -75,11 +75,13 @@ from ..framework import Variable
 from ..resilience import faults as _faults
 from ..resilience.deadline import Deadline, DeadlineExceeded
 from .breaker import CircuitBreaker
+from .slo import SloBurnTracker, parse_latency_targets
 
 __all__ = ["ServingConfig", "ServingEngine", "ServingFuture",
            "ServingError", "Overloaded", "CircuitOpen", "BatchFailed",
            "PoisonRequest", "EngineStopped", "DeadlineExceeded",
-           "HEALTH_SCHEMA_VERSION", "HEALTH_SCHEMA_KEYS"]
+           "HEALTH_SCHEMA_VERSION", "HEALTH_SCHEMA_KEYS",
+           "DEFAULT_TENANT"]
 
 logger = logging.getLogger("paddle_tpu.serving")
 
@@ -95,7 +97,16 @@ HEALTH_SCHEMA_VERSION = 1
 HEALTH_SCHEMA_KEYS = frozenset({
     "schema_version", "status", "ready", "queue_depth", "queue_limit",
     "degraded", "current_max_batch", "open_buckets", "accounting",
+    # additive since the telemetry plane (documented minor change,
+    # docs/SERVING.md "SLO burn rate"): the engine's multi-window SLO
+    # burn state — ok | warning | burning per priority class
+    "slo",
 })
+
+# requests that arrive without a tenant id (the wire field is optional)
+# are accounted under this name so the per-tenant ledger still sums
+# exactly to the fleet ledger
+DEFAULT_TENANT = "anonymous"
 
 
 # ---------------------------------------------------------------------------
@@ -188,6 +199,12 @@ class ServingConfig:
     degraded_min_priority: Optional[int] = None
     bisect_depth: Optional[int] = None          # 0 = no poison bisection
     bisect_quarantine: Optional[int] = None
+    # SLO objectives ('class:seconds,...' latency targets + the error
+    # budget and burn windows; serving/slo.py)
+    slo_latency: Optional[str] = None
+    slo_error_budget: Optional[float] = None
+    slo_fast_window_s: Optional[float] = None
+    slo_slow_window_s: Optional[float] = None
 
     def resolve(self) -> "ServingConfig":
         r = ServingConfig(
@@ -215,6 +232,14 @@ class ServingConfig:
                                            "serving_bisect_depth")),
             bisect_quarantine=int(_flag_default(
                 self.bisect_quarantine, "serving_bisect_quarantine")),
+            slo_latency=str(_flag_default(self.slo_latency,
+                                          "serving_slo_latency_s")),
+            slo_error_budget=float(_flag_default(
+                self.slo_error_budget, "serving_slo_error_budget")),
+            slo_fast_window_s=float(_flag_default(
+                self.slo_fast_window_s, "serving_slo_fast_window_s")),
+            slo_slow_window_s=float(_flag_default(
+                self.slo_slow_window_s, "serving_slo_slow_window_s")),
         )
         if r.max_batch < 1:
             raise ValueError(f"serving: max_batch must be >= 1, got "
@@ -347,6 +372,9 @@ class _Request:
     # sha256 feed fingerprint (computed only when poison bisection is on:
     # the quarantine's key, stable across resubmissions of one feed)
     fp: str = ""
+    # accounting tenant (wire schema v1 optional field; DEFAULT_TENANT
+    # when the caller sent none)
+    tenant: str = DEFAULT_TENANT
     # root span of this request's trace (trace.NOOP_SPAN when off) and
     # the in-flight dispatch child opened by the dispatch thread
     span: Any = _trace.NOOP_SPAN
@@ -430,6 +458,21 @@ class ServingEngine:
         # last N terminal outcomes with their trace ids (accounting()):
         # a failed load_check leg names the exact requests that missed
         self._recent_outcomes: deque = deque(maxlen=64)
+
+        # SLO burn-rate tracker (serving/slo.py): fed one observation per
+        # terminal outcome from _finish_request, serialized into the
+        # health payload's "slo" key. Leaf-locked — it never acquires the
+        # engine lock, so feeding it under _lock cannot deadlock.
+        self._slo = SloBurnTracker(
+            parse_latency_targets(self.config.slo_latency),
+            error_budget=self.config.slo_error_budget,
+            fast_window_s=self.config.slo_fast_window_s,
+            slow_window_s=self.config.slo_slow_window_s)
+        # per-tenant terminal-outcome ledger (tenant_accounting()): its
+        # own leaf lock for the same reason — _finish_request runs both
+        # with and without the engine lock held
+        self._tenant_lock = _monitor.make_lock("ServingEngine._tenant_lock")
+        self._tenant_ledger: Dict[str, dict] = {}
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServingEngine":
@@ -521,17 +564,22 @@ class ServingEngine:
     # -- submission ------------------------------------------------------
     def submit(self, feed: Dict[str, Any], *, priority: int = 0,
                deadline_s: Optional[float] = None,
-               trace_parent=None) -> ServingFuture:
+               trace_parent=None,
+               tenant: Optional[str] = None) -> ServingFuture:
         """Admit one request (any thread). ``feed`` maps every declared
         feed name to an array with a leading batch dim (usually 1).
         Raises a typed :class:`ServingError` subclass when rejected —
         that raise IS the request's terminal outcome. ``trace_parent``
         (a ``trace.Span``/``SpanContext``, e.g. reconstructed from the
         fleet wire headers) parents the request's root span so one trace
-        id follows the request across processes."""
+        id follows the request across processes. ``tenant`` attributes
+        the request in the per-tenant ledger (``tenant_accounting()``
+        and the ``fleet_tenant_*`` metrics); absent means
+        :data:`DEFAULT_TENANT`."""
         # validation first: a malformed feed (ValueError) is a caller bug,
         # not a submitted request — it never enters the accounting
-        req = self._build_request(feed, priority, deadline_s, trace_parent)
+        req = self._build_request(feed, priority, deadline_s, trace_parent,
+                                  tenant)
         # admission runs as a child span of the request root, so a typed
         # rejection still ships a complete (if short) trace
         sub = _trace.start_span("serving.submit", parent=req.span,
@@ -578,7 +626,7 @@ class ServingEngine:
         return req.future
 
     def _build_request(self, feed, priority, deadline_s,
-                       trace_parent=None) -> _Request:
+                       trace_parent=None, tenant=None) -> _Request:
         vals = {}
         nrows = None
         for n in self._feed_names:
@@ -608,9 +656,11 @@ class ServingEngine:
         seq = next(ServingEngine._seq)
         dl = Deadline(budget, what=f"serving request #{seq}") \
             if budget and budget > 0 else None
+        tenant = str(tenant).strip() if tenant is not None else ""
         req = _Request(seq=seq, feed=vals, nrows=nrows, sig=sig,
                        priority=int(priority), deadline=dl,
-                       submitted=time.monotonic(), future=ServingFuture())
+                       submitted=time.monotonic(), future=ServingFuture(),
+                       tenant=tenant or DEFAULT_TENANT)
         if self.config.bisect_depth > 0 and self._quarantine:
             # the fingerprint is only needed eagerly for the admission
             # quarantine lookup; with an empty quarantine the submit hot
@@ -1204,10 +1254,17 @@ class ServingEngine:
             self._record_outcome("completed")
             self._finish_request(r, "completed")
             if _monitor.enabled():
+                # trace exemplar: with the telemetry plane on, the
+                # observation carries this request's trace id into the
+                # bounded per-bucket exemplar ring (JSON metrics form
+                # only); off = no allocation, the plain observe() path
+                ex = r.span.trace_id \
+                    if _monitor.telemetry_enabled() else None
                 _monitor.histogram(
                     "serving_request_latency_seconds",
                     "submit-to-response latency of completed requests "
-                    "(p50/p99 in the snapshot)").observe(latency)
+                    "(p50/p99 in the snapshot)").observe(
+                    latency, exemplar=ex or None)
             r.future._settle(result=res)
 
     # -- helpers ---------------------------------------------------------
@@ -1256,6 +1313,31 @@ class ServingEngine:
         self._recent_outcomes.append(
             {"seq": r.seq, "outcome": outcome,
              "trace_id": r.span.trace_id})
+        # SLO + tenant accounting, once per terminal outcome (this method
+        # is the single chokepoint every settle path funnels through).
+        # Both stores are leaf-locked, never the engine lock.
+        elapsed = time.monotonic() - r.submitted
+        completed = outcome == "completed"
+        self._slo.observe(r.priority, elapsed if completed else None,
+                          error=not completed)
+        with self._tenant_lock:
+            t = self._tenant_ledger.get(r.tenant)
+            if t is None:
+                t = self._tenant_ledger[r.tenant] = {"outcomes": {},
+                                                     "occupancy_s": 0.0}
+            t["outcomes"][outcome] = t["outcomes"].get(outcome, 0) + 1
+            t["occupancy_s"] += elapsed
+        if _monitor.enabled():
+            _monitor.counter(
+                "fleet_tenant_requests_total",
+                "request terminal outcomes by accounting tenant "
+                "(sums exactly to serving_requests_total)").labels(
+                tenant=r.tenant, outcome=outcome).inc()
+            _monitor.counter(
+                "fleet_tenant_occupancy_seconds",
+                "summed submit-to-settle seconds by tenant (time each "
+                "tenant's requests occupied the engine)").labels(
+                tenant=r.tenant).inc(elapsed)
 
     def _settle_error(self, r: _Request, key: str, err: BaseException,
                       locked: bool = False, dispatched: bool = False) -> None:
@@ -1351,6 +1433,21 @@ class ServingEngine:
         acct["recent_outcomes"] = list(self._recent_outcomes)
         return acct
 
+    def tenant_accounting(self) -> dict:
+        """Per-tenant terminal-outcome ledger: ``{tenant: {"outcomes":
+        {outcome: n}, "occupancy_s": float}}``. At quiescence the outcome
+        counts sum exactly to ``accounting()``'s terminal counts — the
+        fleet CI gate's tenant-reconciliation invariant."""
+        with self._tenant_lock:
+            return {t: {"outcomes": dict(v["outcomes"]),
+                        "occupancy_s": v["occupancy_s"]}
+                    for t, v in self._tenant_ledger.items()}
+
+    def slo_state(self) -> dict:
+        """The SLO burn tracker's serialized state (the health payload's
+        ``"slo"`` value); refreshes the ``slo_burn_*`` gauges."""
+        return self._slo.state()
+
     def health(self) -> dict:
         """Liveness/pressure snapshot. This payload is the fleet tier's
         WIRE CONTRACT (``/healthz`` serves it verbatim and the router's
@@ -1373,7 +1470,8 @@ class ServingEngine:
                 "queue_limit": self.config.queue_depth,
                 "degraded": degraded, "current_max_batch": cur_max,
                 "open_buckets": open_buckets,
-                "accounting": self.accounting()}
+                "accounting": self.accounting(),
+                "slo": self._slo.state()}
 
     def ready(self) -> bool:
         """Readiness probe: accepting traffic and the dispatcher is

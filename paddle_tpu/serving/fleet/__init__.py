@@ -32,6 +32,8 @@ from .frontend import FrontendConfig, ServingFrontend
 from .router import FleetRouter, Replica, RouterConfig
 from .supervisor import (ReplicaCrashLoop, ReplicaSupervisor,
                          SupervisedReplica, SupervisorConfig)
+from .telemetry import (METRICS_SCHEMA_VERSION, AggregatorConfig,
+                        FleetAggregator, metrics_json)
 from .wire import (SLO_CLASSES, TRACE_HEADER, WIRE_SCHEMA_VERSION,
                    ReplicaLost, WireError)
 
@@ -40,4 +42,6 @@ __all__ = [
     "RouterConfig", "ReplicaSupervisor", "SupervisorConfig",
     "SupervisedReplica", "ReplicaCrashLoop", "ReplicaLost", "WireError",
     "WIRE_SCHEMA_VERSION", "TRACE_HEADER", "SLO_CLASSES",
+    "FleetAggregator", "AggregatorConfig", "metrics_json",
+    "METRICS_SCHEMA_VERSION",
 ]

@@ -26,6 +26,13 @@ Routes (wire schema: ``fleet.wire``, docs/SERVING.md "Fleet tier"):
 * ``GET /readyz``       — 200/503 on ``ready()`` — the router's and any
   load balancer's routing signal; a draining replica flips 503 here
   while ``/healthz`` keeps answering.
+* ``GET /metrics``      — the replica's monitor registry, Prometheus
+  text exposition format 0.0.4; ``/metrics.json`` (or ``?format=json``)
+  is the schema-versioned JSON form (``telemetry.metrics_json``) that
+  additionally carries histogram trace exemplars, the SLO burn state
+  and the per-tenant ledger. A probe route like ``/healthz``: the
+  ``wire_response`` fault sites never fire here, so the telemetry plane
+  stays observable while the request plane is under chaos.
 
 Trace propagation: the ``X-PT-Trace`` request header carries the
 caller's ``SpanContext`` across the wire; the front-end opens a
@@ -34,7 +41,7 @@ the engine's request root — and every typed outcome and flight-recorder
 incident — shares the caller's trace id across processes.
 
 Metrics (docs/OBSERVABILITY.md): ``fleet_requests_total{route,outcome}``,
-``fleet_request_seconds``, ``fleet_stream_tokens_total``.
+``fleet_request_seconds{route}``, ``fleet_stream_tokens_total``.
 """
 from __future__ import annotations
 
@@ -189,12 +196,18 @@ class ServingFrontend:
             ).labels(route=route, outcome=outcome).inc()
 
     @staticmethod
-    def _observe_latency(seconds: float) -> None:
+    def _observe_latency(seconds: float, route: str,
+                         trace_id: str = "") -> None:
         if _monitor.enabled():
+            # exemplar: the request's trace id rides the bucket this
+            # observation lands in (telemetry plane only — no exemplar
+            # storage is ever allocated while the plane is off)
+            ex = trace_id if _monitor.telemetry_enabled() else ""
             _monitor.histogram(
                 "fleet_request_seconds",
-                "front-end request wall time, admission to response "
-                "written (p50/p99 in the snapshot)").observe(seconds)
+                "front-end request wall time by route, admission to "
+                "response written (p50/p99 in the snapshot)").labels(
+                route=route).observe(seconds, exemplar=ex or None)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -233,14 +246,17 @@ class _Handler(BaseHTTPRequestHandler):
     # -- routes ----------------------------------------------------------
     def do_GET(self):
         with self._track():
-            if self.path == "/healthz":
+            path, _, query = self.path.partition("?")
+            if path == "/healthz":
                 self._send_json(200, self.fe.health_body())
-            elif self.path == "/readyz":
+            elif path == "/readyz":
                 ready = bool(self.fe.engine.ready())
                 self._send_json(200 if ready else 503,
                                 {"schema_version": wire.WIRE_SCHEMA_VERSION,
                                  "ready": ready,
                                  "replica_id": self.fe.replica_id})
+            elif path in ("/metrics", "/metrics.json"):
+                self._metrics(path, query)
             else:
                 self._send_json(404, {"error": {"type": "NotFound",
                                                 "message": self.path}})
@@ -254,6 +270,50 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send_json(404, {"error": {"type": "NotFound",
                                                 "message": self.path}})
+
+    def _metrics(self, path: str, query: str) -> None:
+        """``GET /metrics`` — Prometheus text exposition (0.0.4) of the
+        replica's registry; ``/metrics.json`` / ``?format=json`` is the
+        schema-versioned JSON form with exemplars, SLO state and the
+        tenant ledger. Refreshing ``engine.slo_state()`` first keeps the
+        ``slo_burn_*`` gauges current in BOTH forms. No fault injection
+        fires here (probe route — see ``_respond_best_effort``)."""
+        from urllib.parse import parse_qs
+
+        from . import telemetry
+
+        fe = self.fe
+        # getattr-guarded: a bare engine double (tests) without the SLO
+        # tracker or tenant ledger still serves its registry
+        slo = tenants = None
+        slo_fn = getattr(fe.engine, "slo_state", None)
+        if callable(slo_fn):
+            slo = slo_fn()    # side effect: refreshes slo_burn_* gauges
+        ten_fn = getattr(fe.engine, "tenant_accounting", None)
+        if callable(ten_fn):
+            tenants = ten_fn()
+        fmt = (parse_qs(query).get("format") or [""])[0]
+        if path.endswith(".json") or fmt == "json":
+            body = telemetry.metrics_json(
+                replica_id=fe.replica_id, slo=slo, tenants=tenants)
+            self._send_raw(200, "application/json", wire.dumps(body))
+        else:
+            text = _monitor.get_registry().to_prometheus()
+            self._send_raw(200, "text/plain; version=0.0.4; charset=utf-8",
+                           text.encode("utf-8"))
+
+    def _send_raw(self, status: int, content_type: str,
+                  raw: bytes) -> None:
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+        except (BrokenPipeError, ConnectionResetError, TimeoutError,
+                OSError):
+            logger.debug("fleet frontend: scraper gone before the "
+                         "metrics body was written")
 
     def _track(self):
         fe = self.fe
@@ -285,7 +345,8 @@ class _Handler(BaseHTTPRequestHandler):
                 feed, priority=priority,
                 deadline_s=float(deadline_s)
                 if deadline_s is not None else None,
-                trace_parent=span if span else self._trace_parent())
+                trace_parent=span if span else self._trace_parent(),
+                tenant=wire.resolve_tenant(body))
         except Exception as e:
             # NOTHING was admitted (validation bug or a submit-time
             # typed rejection): the router may safely redispatch
@@ -306,7 +367,8 @@ class _Handler(BaseHTTPRequestHandler):
         span.set_attribute("outcome", "completed")
         span.end()
         fe._count("submit", "completed")
-        fe._observe_latency(time.monotonic() - t0)
+        fe._observe_latency(time.monotonic() - t0, "submit",
+                            fut.trace_id)
         self._respond_best_effort(200,
                                   wire.encode_outputs(outs, fut.trace_id))
 
@@ -374,7 +436,8 @@ class _Handler(BaseHTTPRequestHandler):
                 priority=wire.resolve_priority(body),
                 deadline_s=float(deadline_s)
                 if deadline_s is not None else None,
-                trace_parent=span if span else self._trace_parent())
+                trace_parent=span if span else self._trace_parent(),
+                tenant=wire.resolve_tenant(body))
         except Exception as e:
             # nothing streamed yet: a plain typed error response, so the
             # router can still classify admitted vs unadmitted by status
@@ -419,7 +482,8 @@ class _Handler(BaseHTTPRequestHandler):
                 span.end(error=outcome)
                 fe._count("generate", type(outcome).__name__)
             self._chunk(None)   # chunked-encoding terminator
-            fe._observe_latency(time.monotonic() - t0)
+            fe._observe_latency(time.monotonic() - t0, "generate",
+                                fut.trace_id)
             if _monitor.enabled() and streamed:
                 _monitor.counter(
                     "fleet_stream_tokens_total",

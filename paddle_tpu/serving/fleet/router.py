@@ -120,7 +120,8 @@ class Replica:
     def _fresh_snap() -> Dict[str, Any]:
         return {"ok": False, "ready": False, "queue_depth": 0,
                 "degraded": False, "open_buckets": 0, "generative": False,
-                "status": "unknown", "polled_at": 0.0}
+                "status": "unknown", "slo_state": "unknown",
+                "polled_at": 0.0}
 
     @property
     def address(self) -> str:
@@ -335,6 +336,8 @@ class FleetRouter:
                 body = {}
             if not isinstance(body, dict):
                 body = {}
+            slo = body.get("slo") if isinstance(body.get("slo"),
+                                               dict) else {}
             return {"ok": resp.status == 200,
                     "ready": bool(body.get("ready")),
                     "queue_depth": int(body.get("queue_depth", 0)),
@@ -342,12 +345,14 @@ class FleetRouter:
                     "open_buckets": len(body.get("open_buckets") or ()),
                     "generative": bool(body.get("generative")),
                     "status": str(body.get("status", "unknown")),
+                    "slo_state": str(slo.get("state", "unknown")),
                     "polled_at": time.monotonic()}
         except Exception as e:
             return {"ok": False, "ready": False, "queue_depth": 0,
                     "degraded": False, "open_buckets": 0,
                     "generative": False,
                     "status": f"unreachable:{type(e).__name__}",
+                    "slo_state": "unknown",
                     "polled_at": time.monotonic()}
 
     # -- per-replica transport breaker -----------------------------------
@@ -518,7 +523,8 @@ class FleetRouter:
     # -- submit ----------------------------------------------------------
     def submit(self, feed: Dict[str, Any], *, priority: Optional[int] = None,
                slo_class: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> List[np.ndarray]:
+               deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None) -> List[np.ndarray]:
         """Route one request/response inference call. Returns the fetch
         rows, or raises the SAME typed outcome classes the in-process
         engine raises (reconstructed from the wire), plus
@@ -532,6 +538,8 @@ class FleetRouter:
             body["slo_class"] = slo_class
         if deadline_s is not None:
             body["deadline_s"] = float(deadline_s)
+        if tenant is not None:
+            body["tenant"] = str(tenant)
         span = _trace.root_span("router.request", route="submit")
         self._note_submitted()
         t0 = time.monotonic()
@@ -765,7 +773,8 @@ class FleetRouter:
     def generate(self, prompt, *, max_new_tokens: Optional[int] = None,
                  priority: Optional[int] = None,
                  slo_class: Optional[str] = None,
-                 deadline_s: Optional[float] = None) -> Iterator[int]:
+                 deadline_s: Optional[float] = None,
+                 tenant: Optional[str] = None) -> Iterator[int]:
         """Route one generation request and stream its tokens. The
         returned iterator yields ints as the replica emits them and ends
         with normal exhaustion on completion — or raises the typed
@@ -786,6 +795,8 @@ class FleetRouter:
             body["slo_class"] = slo_class
         if deadline_s is not None:
             body["deadline_s"] = float(deadline_s)
+        if tenant is not None:
+            body["tenant"] = str(tenant)
         span = _trace.root_span("router.request", route="generate")
         self._note_submitted()
         t0 = time.monotonic()

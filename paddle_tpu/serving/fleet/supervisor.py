@@ -185,6 +185,7 @@ class ReplicaSupervisor:
         self._lock = _monitor.make_lock("ReplicaSupervisor._lock")
         self._stop_ev = threading.Event()
         self.replicas: Dict[str, SupervisedReplica] = {}
+        self._aggregator = None   # telemetry plane, see start_telemetry
 
     # -- public surface --------------------------------------------------
     def add_replica(self, replica_id: str, model: str = "mlp_tiny",
@@ -244,10 +245,30 @@ class ReplicaSupervisor:
     def status(self) -> Dict[str, dict]:
         return {h.replica_id: h.status() for h in self._handles()}
 
+    def start_telemetry(self, config=None):
+        """Attach a :class:`~.telemetry.FleetAggregator` scraping this
+        supervisor's router membership (restarted replicas are picked
+        up within one scrape, exactly like the routing poll). Returns
+        the aggregator, or ``None`` without a router or while
+        ``FLAGS_fleet_telemetry`` is off (the disabled plane spawns no
+        thread)."""
+        from . import telemetry
+
+        if self.router is None or not telemetry.enabled():
+            return None
+        if self._aggregator is None:
+            self._aggregator = telemetry.FleetAggregator.for_router(
+                self.router, config)
+            self._aggregator.start()
+        return self._aggregator
+
     def stop(self, drain: bool = True) -> None:
         """Stop supervising: no further restarts; drain (or kill) every
         live replica and join the monitor threads."""
         self._stop_ev.set()
+        agg, self._aggregator = self._aggregator, None
+        if agg is not None:
+            agg.stop()
         handles = self._handles()
         for h in handles:
             h.stop_requested = True

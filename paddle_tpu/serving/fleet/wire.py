@@ -36,7 +36,8 @@ __all__ = [
     "WIRE_SCHEMA_VERSION", "TRACE_HEADER", "SLO_CLASSES",
     "encode_array", "decode_array", "encode_feed", "decode_feed",
     "status_for", "error_body", "error_from_body", "resolve_priority",
-    "response_is_unadmitted", "ReplicaLost", "WireError",
+    "resolve_tenant", "response_is_unadmitted", "ReplicaLost",
+    "WireError",
 ]
 
 WIRE_SCHEMA_VERSION = 1
@@ -118,6 +119,35 @@ def resolve_priority(body: dict) -> int:
         raise WireError(f"unknown slo_class {slo!r} "
                         f"(known: {sorted(SLO_CLASSES)})")
     return SLO_CLASSES[slo]
+
+
+# accounting tenants become metric label values (fleet_tenant_*); the
+# charset bound keeps hostile ids out of the exposition format and the
+# length bound keeps one caller from exploding label cardinality storage
+_TENANT_MAX_LEN = 64
+
+
+def resolve_tenant(body: dict) -> Optional[str]:
+    """The optional ``tenant`` field (wire schema v1, additive): a short
+    accounting id string, validated here so a hostile value is a 400
+    ``WireError`` — a caller bug, never a submitted request. ``None``
+    when absent (the engine accounts it under its default tenant)."""
+    tenant = body.get("tenant")
+    if tenant is None:
+        return None
+    if not isinstance(tenant, str):
+        raise WireError(f"tenant must be a string, "
+                        f"got {type(tenant).__name__}")
+    tenant = tenant.strip()
+    if not tenant:
+        return None
+    if len(tenant) > _TENANT_MAX_LEN:
+        raise WireError(f"tenant id longer than {_TENANT_MAX_LEN} chars")
+    if not all(c.isalnum() or c in "-_.:@" for c in tenant):
+        raise WireError(
+            "tenant id may only contain alphanumerics and - _ . : @ "
+            f"(got {tenant!r})")
+    return tenant
 
 
 # ---------------------------------------------------------------------------

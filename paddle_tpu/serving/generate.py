@@ -50,8 +50,8 @@ from .. import trace as _trace
 from ..core.types import np_dtype
 from ..resilience import faults as _faults
 from ..resilience.deadline import Deadline, DeadlineExceeded
-from .engine import (BatchFailed, EngineStopped, ServingConfig,
-                     ServingEngine, ServingFuture, _Request)
+from .engine import (DEFAULT_TENANT, BatchFailed, EngineStopped,
+                     ServingConfig, ServingEngine, ServingFuture, _Request)
 
 __all__ = ["GenerationConfig", "GenerativeEngine"]
 
@@ -173,15 +173,17 @@ class GenerativeEngine(ServingEngine):
     # -- submission ------------------------------------------------------
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                priority: int = 0, deadline_s: Optional[float] = None,
-               trace_parent=None) -> ServingFuture:
+               trace_parent=None,
+               tenant: Optional[str] = None) -> ServingFuture:
         """Admit one generation request (any thread). ``prompt`` is a 1-D
         int token array (a ``[1, L]`` row is accepted); the returned
         future STREAMS tokens (``ServingFuture.stream()``) and settles
         exactly once with the full token array or a typed error.
-        ``trace_parent`` parents the request root span (fleet wire
+        ``trace_parent`` parents the request root span and ``tenant``
+        attributes the request in the per-tenant ledger (fleet wire
         propagation — see ``ServingEngine.submit``)."""
         req = self._build_gen_request(prompt, max_new_tokens, priority,
-                                      deadline_s, trace_parent)
+                                      deadline_s, trace_parent, tenant)
         sub = _trace.start_span("serving.submit", parent=req.span,
                                 priority=req.priority,
                                 prompt_len=len(req.prompt))
@@ -190,7 +192,8 @@ class GenerativeEngine(ServingEngine):
         return self._admit_and_enqueue(req, sub)
 
     def _build_gen_request(self, prompt, max_new_tokens, priority,
-                           deadline_s, trace_parent=None) -> _GenRequest:
+                           deadline_s, trace_parent=None,
+                           tenant=None) -> _GenRequest:
         prompt = np.asarray(prompt)
         if prompt.ndim == 2 and prompt.shape[0] == 1:
             prompt = prompt[0]
@@ -220,9 +223,11 @@ class GenerativeEngine(ServingEngine):
         seq = next(ServingEngine._seq)
         dl = Deadline(budget, what=f"serving generation #{seq}") \
             if budget and budget > 0 else None
+        tenant = str(tenant).strip() if tenant is not None else ""
         req = _GenRequest(seq=seq, feed={}, nrows=1, sig=("gen", bucket),
                           priority=int(priority), deadline=dl,
                           submitted=time.monotonic(), future=ServingFuture(),
+                          tenant=tenant or DEFAULT_TENANT,
                           prompt=prompt, bucket=bucket, max_new=max_new)
         req.span = self._request_root(trace_parent, seq=seq,
                                       prompt_len=L, max_new=max_new,
@@ -468,10 +473,14 @@ class GenerativeEngine(ServingEngine):
             self._record_outcome("completed")
             self._finish_request(r, "completed")
             if _monitor.enabled():
+                # same exemplar contract as the base engine's _distribute
+                ex = r.span.trace_id \
+                    if _monitor.telemetry_enabled() else None
                 _monitor.histogram(
                     "serving_request_latency_seconds",
                     "submit-to-response latency of completed requests "
-                    "(p50/p99 in the snapshot)").observe(latency)
+                    "(p50/p99 in the snapshot)").observe(
+                    latency, exemplar=ex or None)
             r.future._settle(
                 result=[np.asarray(r.out_tokens, dtype=np.int64)])
 

@@ -1,0 +1,588 @@
+"""Fleet telemetry plane (paddle_tpu.serving.fleet.telemetry +
+monitor exemplars/merge + serving SLO/tenant hooks).
+
+Covers the ISSUE-18 contract in-process: the two ``/metrics`` forms and
+their frozen JSON schema, Prometheus exposition-format conformance
+round-tripped through the scrape-side parser (hostile label fuzz), the
+EXACT histogram merge property, the SLO burn-rate tracker's multi-window
+state machine, per-tenant accounting exactness, trace exemplars (and
+their disabled-path non-allocation), and the FleetAggregator's typed
+scrape-failure degradation. The multi-process leg is
+``tools/load_check.py --fleet`` (``leg_fleet_telemetry``)."""
+import http.client
+import json
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor, serving
+from paddle_tpu.monitor.registry import MetricsRegistry
+from paddle_tpu.serving.fleet import (AggregatorConfig, FleetAggregator,
+                                      ServingFrontend, WireError, telemetry,
+                                      wire)
+from paddle_tpu.serving.slo import SloBurnTracker
+
+
+@pytest.fixture(autouse=True)
+def _flags_reset():
+    from paddle_tpu import flags as flags_mod
+
+    snap = dict(flags_mod._overrides)
+    yield
+    flags_mod._overrides.clear()
+    flags_mod._overrides.update(snap)
+    flags_mod._set_epoch += 1
+
+
+def _build_infer(hidden=4, in_dim=13):
+    import paddle_tpu.unique_name as un
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[in_dim], dtype="float32")
+            pred = fluid.layers.fc(x, hidden, act="softmax")
+        infer = main.clone(for_test=True)
+    return infer, startup, pred.name
+
+
+def _engine(**cfg_kw):
+    infer, startup, pred = _build_infer()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    cfg = serving.ServingConfig(max_batch=cfg_kw.pop("max_batch", 4),
+                                **cfg_kw)
+    return serving.ServingEngine(infer, feed_names=["x"],
+                                 fetch_list=[pred], scope=scope,
+                                 executor=exe, config=cfg)
+
+
+def _feed(rows=1, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.rand(rows, 13).astype(np.float32)}
+
+
+@pytest.fixture()
+def frontend():
+    eng = _engine(batch_window_s=0.005)
+    eng.warm_up()
+    eng.start()
+    fe = ServingFrontend(eng, replica_id="t0")
+    fe.start()
+    yield fe
+    fe.stop(wait_inflight_s=2.0)
+    eng.stop(drain=False)
+
+
+def _get_raw(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.getheader("Content-Type"), resp.read()
+    finally:
+        conn.close()
+
+
+def _post_submit(port, body, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/submit", body=wire.dumps(body),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, wire.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _stub_server(holder):
+    """An HTTP stub answering every GET with ``holder['body']`` /
+    ``holder['status']`` — the aggregator's hostile-peer stand-in."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = holder["body"]
+            self.send_response(holder.get("status", 200))
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+# ---------------------------------------------------------------------------
+# /metrics routes + frozen JSON schema
+# ---------------------------------------------------------------------------
+
+def test_metrics_route_serves_prometheus_text(frontend):
+    frontend.engine.submit(_feed()).result(timeout=60)
+    status, ctype, raw = _get_raw(frontend.port, "/metrics")
+    assert status == 200
+    assert ctype == "text/plain; version=0.0.4; charset=utf-8"
+    parsed = monitor.parse_prometheus_text(raw)
+    assert "serving_requests_total" in parsed
+    assert parsed["serving_requests_total"].kind == "counter"
+    assert "serving_request_latency_seconds" in parsed
+
+
+def test_metrics_json_route_schema_frozen(frontend):
+    frontend.engine.submit(_feed()).result(timeout=60)
+    for path in ("/metrics.json", "/metrics?format=json"):
+        status, ctype, raw = _get_raw(frontend.port, path)
+        assert status == 200
+        assert ctype == "application/json"
+        doc = json.loads(raw.decode("utf-8"))
+        # the key set is FROZEN exactly like the health payload: any
+        # drift is a schema-version bump, not a silent addition
+        assert set(doc) == set(telemetry.METRICS_SCHEMA_KEYS)
+        assert doc["schema_version"] == telemetry.METRICS_SCHEMA_VERSION
+        assert doc["replica_id"] == "t0"
+        assert "serving_requests_total" in doc["families"]
+        assert doc["slo"]["state"] in ("ok", "warning", "burning")
+        assert isinstance(doc["tenants"], dict)
+
+
+def test_metrics_probe_route_immune_to_wire_faults(frontend):
+    """/metrics (like /healthz) is a probe route: response fault plans
+    must not touch it — telemetry stays observable under chaos."""
+    from paddle_tpu.resilience import fault_plan_guard
+
+    with fault_plan_guard("wire_response:99:RuntimeError"):
+        status, _, raw = _get_raw(frontend.port, "/metrics")
+        assert status == 200 and raw
+
+
+# ---------------------------------------------------------------------------
+# fleet_request_seconds route label (satellite regression)
+# ---------------------------------------------------------------------------
+
+def test_fleet_request_seconds_labeled_by_route(frontend):
+    status, _ = _post_submit(frontend.port,
+                             {"feed": wire.encode_feed(_feed())})
+    assert status == 200
+    fam = monitor.get_registry().get("fleet_request_seconds")
+    assert fam is not None and fam.kind == "histogram"
+    label_sets = [labels for labels, _ in fam.children()]
+    assert {"route": "submit"} in label_sets
+    # every child carries the route label — no unlabeled series left
+    # (submit vs generate vs future routes stay distinguishable)
+    assert all("route" in labels for labels in label_sets)
+    snap = monitor.metric_value("fleet_request_seconds", route="submit")
+    assert snap["count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition conformance: escape + round-trip fuzz
+# ---------------------------------------------------------------------------
+
+HOSTILE_LABELS = ["plain", "back\\slash", "new\nline", 'quo"te',
+                  "both\\\"\n", "trailing\\", "uni·codé",
+                  "le=\"0.5\"} fake 1"]
+
+
+def test_prom_text_roundtrips_hostile_label_values():
+    reg = MetricsRegistry()
+    c = reg.counter("fuzz_total", 'help with \\, a\nnewline and "quotes"')
+    for i, v in enumerate(HOSTILE_LABELS):
+        c.labels(tenant=v).inc(i + 1)
+    parsed = monitor.parse_prometheus_text(reg.to_prometheus())
+    fam = parsed["fuzz_total"]
+    assert fam.help == 'help with \\, a\nnewline and "quotes"'
+    for i, v in enumerate(HOSTILE_LABELS):
+        assert fam.value(tenant=v) == i + 1
+
+
+def test_prom_text_type_lines_for_labeled_gauges():
+    reg = MetricsRegistry()
+    reg.gauge("g_labeled", "labeled gauge").labels(replica="r0").set(2.0)
+    text = reg.to_prometheus()
+    assert "# TYPE g_labeled gauge" in text
+    parsed = monitor.parse_prometheus_text(text)
+    assert parsed["g_labeled"].kind == "gauge"
+    assert parsed["g_labeled"].value(replica="r0") == 2.0
+
+
+def test_prom_histogram_roundtrips_through_scrape_parser():
+    reg = MetricsRegistry()
+    h = reg.histogram("rt_seconds", "round trip", buckets=(0.5, 1.0, 2.0))
+    for v in (0.25, 0.75, 1.5, 9.0):
+        h.observe(v)
+    parsed = monitor.parse_prometheus_text(reg.to_prometheus())
+    snap = monitor.histogram_snapshot_from_samples(parsed["rt_seconds"])
+    direct = reg.get("rt_seconds")._children[()].snapshot()
+    assert snap["count"] == direct["count"] == 4
+    assert snap["sum"] == pytest.approx(direct["sum"])
+    assert snap["buckets"] == direct["buckets"]
+
+
+def test_prom_parser_refuses_garbage():
+    with pytest.raises(monitor.PromParseError):
+        monitor.parse_prometheus_text(b"\x00\xffdefinitely{not metrics")
+
+
+# ---------------------------------------------------------------------------
+# exact histogram merge (satellite property test)
+# ---------------------------------------------------------------------------
+
+def test_histogram_merge_equals_union_stream():
+    """merge(a, b) must equal ONE histogram that observed the union
+    stream: count, sum, every cumulative bucket, p50/p99. Values are
+    binary-exact (multiples of 1/64) so float summation order cannot
+    blur the equality."""
+    buckets = (0.25, 0.5, 1.0, 2.0)
+    rng = np.random.RandomState(7)
+    stream_a = [int(x) / 64.0 for x in rng.randint(1, 160, size=57)]
+    stream_b = [int(x) / 64.0 for x in rng.randint(1, 160, size=43)]
+
+    reg = MetricsRegistry()
+    ha = reg.histogram("ha", "", buckets=buckets)
+    hb = reg.histogram("hb", "", buckets=buckets)
+    hu = reg.histogram("hu", "", buckets=buckets)
+    for v in stream_a:
+        ha.observe(v)
+    for v in stream_b:
+        hb.observe(v)
+    for v in stream_a + stream_b:
+        hu.observe(v)
+    snap_a = reg.get("ha")._children[()].snapshot()
+    snap_b = reg.get("hb")._children[()].snapshot()
+    union = reg.get("hu")._children[()].snapshot()
+
+    merged = monitor.merge_histogram_snapshots([snap_a, snap_b])
+    assert merged["count"] == union["count"] == 100
+    assert merged["sum"] == pytest.approx(union["sum"])
+    assert merged["buckets"] == union["buckets"]
+    assert merged["min"] == union["min"]
+    assert merged["max"] == union["max"]
+    assert merged["p50"] == pytest.approx(union["p50"])
+    assert merged["p99"] == pytest.approx(union["p99"])
+
+
+def test_histogram_merge_refuses_mismatched_buckets():
+    reg = MetricsRegistry()
+    h1 = reg.histogram("m1", "", buckets=(0.5, 1.0))
+    h2 = reg.histogram("m2", "", buckets=(0.25, 1.0))
+    h1.observe(0.3)
+    h2.observe(0.3)
+    s1 = reg.get("m1")._children[()].snapshot()
+    s2 = reg.get("m2")._children[()].snapshot()
+    with pytest.raises(ValueError):
+        monitor.merge_histogram_snapshots([s1, s2])
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate tracker
+# ---------------------------------------------------------------------------
+
+def test_slo_tracker_multiwindow_state_machine():
+    t = [1000.0]
+    tr = SloBurnTracker({"standard": 0.5}, error_budget=0.1,
+                        fast_window_s=10.0, slow_window_s=60.0,
+                        _now=lambda: t[0])
+    for _ in range(20):
+        tr.observe(1, 0.1, error=False)
+    s = tr.state()
+    assert s["state"] == "ok"
+    assert s["classes"]["standard"]["fast_burn"] == 0.0
+    # bads: errors AND too-slow completions both consume budget
+    for _ in range(3):
+        tr.observe(1, None, error=True)
+    tr.observe(1, 0.9, error=False)   # completed, but slower than target
+    s = tr.state()
+    assert s["state"] == "burning"    # both windows hot
+    assert s["classes"]["standard"]["bad"] == 4
+    t[0] += 15.0                      # bads leave the FAST window only
+    s = tr.state()
+    assert s["state"] == "warning"
+    t[0] += 60.0                      # ...and then the slow window
+    s = tr.state()
+    assert s["state"] == "ok"
+
+
+def test_slo_state_rides_health_payload():
+    eng = _engine()
+    try:
+        assert "slo" in serving.HEALTH_SCHEMA_KEYS
+        h = eng.health()
+        assert h["slo"]["state"] == "ok"
+        assert set(h["slo"]["classes"]) == {"batch", "standard",
+                                            "interactive"}
+    finally:
+        eng.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# per-tenant accounting
+# ---------------------------------------------------------------------------
+
+def test_wire_tenant_field_validation():
+    assert wire.resolve_tenant({}) is None
+    assert wire.resolve_tenant({"tenant": "  "}) is None
+    assert wire.resolve_tenant({"tenant": "a-b_c.d:e@f"}) == "a-b_c.d:e@f"
+    with pytest.raises(WireError):
+        wire.resolve_tenant({"tenant": 7})
+    with pytest.raises(WireError):
+        wire.resolve_tenant({"tenant": "x" * 65})
+    with pytest.raises(WireError):
+        wire.resolve_tenant({"tenant": "sp ace"})
+    with pytest.raises(WireError):
+        wire.resolve_tenant({"tenant": 'quo"te{}'})
+
+
+def test_tenant_ledger_sums_exactly_to_accounting():
+    from paddle_tpu.serving.engine import DEFAULT_TENANT
+
+    eng = _engine(batch_window_s=0.005)
+    eng.warm_up()
+    eng.start()
+    try:
+        futs = [eng.submit(_feed(seed=i), tenant="acme") for i in range(3)]
+        futs += [eng.submit(_feed(seed=9), tenant="globex")]
+        futs += [eng.submit(_feed(seed=10))]          # default tenant
+        for f in futs:
+            f.result(timeout=60)
+        ledger = eng.tenant_accounting()
+        assert ledger["acme"]["outcomes"]["completed"] == 3
+        assert ledger["globex"]["outcomes"]["completed"] == 1
+        assert ledger[DEFAULT_TENANT]["outcomes"]["completed"] >= 1
+        assert all(t["occupancy_s"] > 0 for t in ledger.values())
+        # the reconciliation invariant: tenant outcome sums == the
+        # engine's own terminal ledger, outcome by outcome
+        sums = {}
+        for t in ledger.values():
+            for o, n in t["outcomes"].items():
+                sums[o] = sums.get(o, 0) + n
+        assert sums == {"completed": eng.accounting()["completed"]}
+    finally:
+        eng.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# trace exemplars
+# ---------------------------------------------------------------------------
+
+def test_exemplars_recorded_when_plane_enabled():
+    fluid.set_flags({"FLAGS_fleet_telemetry": 1, "FLAGS_trace": 1})
+    monitor.reset()
+    eng = _engine(batch_window_s=0.005)
+    eng.warm_up()
+    eng.start()
+    try:
+        fut = eng.submit(_feed())
+        fut.result(timeout=60)
+        fam = monitor.get_registry().get("serving_request_latency_seconds")
+        (_, child), = fam.children()
+        ex = child.exemplars()
+        assert ex, "enabled plane must record exemplars"
+        rings = [e for ring in ex.values() for e in ring]
+        assert any(e["trace_id"] == fut.trace_id for e in rings)
+        # and they ride the JSON form only
+        doc = telemetry.metrics_json(replica_id="x")
+        assert "serving_request_latency_seconds" in doc["exemplars"]
+        assert "exemplar" not in monitor.get_registry().to_prometheus()
+    finally:
+        eng.stop(drain=False)
+
+
+def test_exemplars_disabled_path_never_allocates():
+    monitor.reset()
+    eng = _engine(batch_window_s=0.005)
+    eng.warm_up()
+    eng.start()
+    try:
+        eng.submit(_feed()).result(timeout=60)
+        fam = monitor.get_registry().get("serving_request_latency_seconds")
+        (_, child), = fam.children()
+        # not "empty exemplars" — NO ring storage at all (the observe
+        # path passed exemplar=None, the true-no-op contract)
+        assert child._exemplars is None
+        assert child.exemplars() == {}
+        doc = telemetry.metrics_json(replica_id="x")
+        assert doc["exemplars"] == {}
+    finally:
+        eng.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# FleetAggregator
+# ---------------------------------------------------------------------------
+
+def test_aggregator_disabled_start_is_noop():
+    agg = FleetAggregator([("r0", "127.0.0.1:1")])
+    assert agg.start() is agg
+    assert agg._thread is None          # no scrape thread while off
+    agg.stop()
+
+
+def test_aggregator_scrapes_live_frontend_both_modes(frontend):
+    fluid.set_flags({"FLAGS_fleet_telemetry": 1})
+    for _ in range(3):
+        frontend.engine.submit(_feed()).result(timeout=60)
+    for mode in ("json", "prom"):
+        agg = FleetAggregator(
+            [("t0", f"127.0.0.1:{frontend.port}")],
+            AggregatorConfig(scrape_interval_s=60.0, scrape_timeout_s=10.0,
+                             mode=mode))
+        agg.poll_now()
+        snap = agg.snapshot()
+        rec = snap["replicas"]["t0"]
+        assert rec["up"] and not rec["stale"]
+        assert rec["scrape_age_s"] < 60.0
+        assert rec["outcomes"]["completed"] >= 3
+        assert snap["fleet"]["p50"] is not None
+        assert snap["fleet"]["latency"]["count"] >= 3
+        assert monitor.metric_value("fleet_agg_up", replica="t0") == 1.0
+    # the JSON mode additionally carries SLO + tenants over the wire
+    agg = FleetAggregator([("t0", f"127.0.0.1:{frontend.port}")],
+                          AggregatorConfig(scrape_interval_s=60.0,
+                                           scrape_timeout_s=10.0))
+    agg.poll_now()
+    rec = agg.snapshot()["replicas"]["t0"]
+    assert rec["slo"]["state"] in ("ok", "warning", "burning")
+    assert rec["tenants"] is not None
+
+
+def test_aggregator_typed_connect_failure_degrades_stale():
+    fluid.set_flags({"FLAGS_fleet_telemetry": 1})
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()                           # nobody listens here anymore
+    agg = FleetAggregator([("gone", f"127.0.0.1:{dead_port}")],
+                          AggregatorConfig(scrape_interval_s=60.0,
+                                           scrape_timeout_s=2.0))
+    agg.poll_now()
+    agg.poll_now()
+    rec = agg.snapshot()["replicas"]["gone"]
+    assert rec["up"] is False and rec["stale"] is True
+    assert rec["error"] == "connect"
+    assert rec["consecutive_failures"] == 2
+    assert monitor.metric_value("fleet_scrape_failures_total", default=0,
+                                replica="gone", kind="connect") >= 2
+
+
+def test_aggregator_corrupt_body_keeps_last_good_snapshot():
+    fluid.set_flags({"FLAGS_fleet_telemetry": 1})
+    reg = MetricsRegistry()
+    h = reg.histogram(telemetry.REQUEST_LATENCY_METRIC, "lat")
+    h.observe(0.2)
+    holder = {"body": json.dumps(
+        telemetry.metrics_json(registry=reg, replica_id="s0")
+    ).encode("utf-8")}
+    srv, port = _stub_server(holder)
+    try:
+        agg = FleetAggregator([("s0", f"127.0.0.1:{port}")],
+                              AggregatorConfig(scrape_interval_s=60.0,
+                                               scrape_timeout_s=10.0))
+        agg.poll_now()
+        rec = agg.snapshot()["replicas"]["s0"]
+        assert rec["up"] and rec["latency"]["count"] == 1
+
+        holder["body"] = b"\x00\xffnot a metrics body"
+        agg.poll_now()
+        rec = agg.snapshot()["replicas"]["s0"]
+        # degraded, typed — but the LAST GOOD latency data survives,
+        # marked stale with a growing age
+        assert rec["up"] is False and rec["stale"] is True
+        assert rec["error"] == "corrupt"
+        assert rec["consecutive_failures"] == 1
+        assert rec["latency"]["count"] == 1
+        assert agg.snapshot()["fleet"]["p50"] is not None
+        assert monitor.metric_value(
+            "fleet_scrape_failures_total", default=0,
+            replica="s0", kind="corrupt") >= 1
+    finally:
+        srv.shutdown()
+
+
+def test_aggregator_refuses_newer_schema_as_corrupt():
+    fluid.set_flags({"FLAGS_fleet_telemetry": 1})
+    holder = {"body": json.dumps(
+        {"schema_version": telemetry.METRICS_SCHEMA_VERSION + 1,
+         "families": {}}).encode("utf-8")}
+    srv, port = _stub_server(holder)
+    try:
+        agg = FleetAggregator([("vnew", f"127.0.0.1:{port}")],
+                              AggregatorConfig(scrape_interval_s=60.0,
+                                               scrape_timeout_s=10.0))
+        agg.poll_now()
+        rec = agg.snapshot()["replicas"]["vnew"]
+        assert rec["error"] == "corrupt" and rec["stale"] is True
+    finally:
+        srv.shutdown()
+
+
+def test_aggregator_counter_reset_clamps_rate():
+    """A restarted replica's counters drop to zero: the windowed delta
+    must clamp to the new absolute value, never go negative."""
+    fluid.set_flags({"FLAGS_fleet_telemetry": 1})
+
+    def doc(completed):
+        return json.dumps({
+            "schema_version": 1, "replica_id": "s1", "exemplars": {},
+            "slo": None, "tenants": None,
+            "families": {"serving_requests_total": {
+                "kind": "counter", "help": "",
+                "values": [{"labels": {"outcome": "completed"},
+                            "value": completed}]}}}).encode("utf-8")
+
+    holder = {"body": doc(50)}
+    srv, port = _stub_server(holder)
+    try:
+        agg = FleetAggregator([("s1", f"127.0.0.1:{port}")],
+                              AggregatorConfig(scrape_interval_s=60.0,
+                                               scrape_timeout_s=10.0))
+        agg.poll_now()
+        holder["body"] = doc(2)          # restart: 50 -> 2
+        agg.poll_now()
+        rec = agg.snapshot()["replicas"]["s1"]
+        rate = rec["rates"]["serving_requests_total"]["outcome=completed"]
+        assert rate > 0                  # clamped to the new absolute
+        assert rec["counters"]["serving_requests_total"][
+            "outcome=completed"] == 2
+    finally:
+        srv.shutdown()
+
+
+def test_aggregator_fleet_rollup_sums_and_worst_slo(frontend):
+    fluid.set_flags({"FLAGS_fleet_telemetry": 1})
+    frontend.engine.submit(_feed()).result(timeout=60)
+    # second "replica": a stub replaying a burning registry
+    reg = MetricsRegistry()
+    reg.histogram(telemetry.REQUEST_LATENCY_METRIC, "lat").observe(0.1)
+    reg.counter(telemetry.OUTCOME_COUNTER, "").labels(
+        outcome="completed").inc(7)
+    holder = {"body": json.dumps(telemetry.metrics_json(
+        registry=reg, replica_id="s2",
+        slo={"state": "burning", "classes": {}})).encode("utf-8")}
+    srv, port = _stub_server(holder)
+    try:
+        agg = FleetAggregator(
+            [("t0", f"127.0.0.1:{frontend.port}"),
+             ("s2", f"127.0.0.1:{port}")],
+            AggregatorConfig(scrape_interval_s=60.0, scrape_timeout_s=10.0))
+        agg.poll_now()
+        snap = agg.snapshot()
+        fleet = snap["fleet"]
+        n_t0 = snap["replicas"]["t0"]["outcomes"]["completed"]
+        assert fleet["outcomes"]["completed"] == n_t0 + 7
+        assert fleet["latency"]["count"] == \
+            snap["replicas"]["t0"]["latency"]["count"] + 1
+        assert fleet["slo_state"] == "burning"   # the WORST across replicas
+        assert monitor.metric_value(
+            "fleet_agg_slo_state",
+            replica=telemetry.FLEET_LABEL) == 2.0
+    finally:
+        srv.shutdown()

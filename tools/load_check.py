@@ -465,12 +465,13 @@ class _ReplicaProc:
     events, SIGTERM-drain, reap."""
 
     def __init__(self, model: str, replica_id: str, aot_dir: str = "",
-                 log_dir: str = "."):
+                 log_dir: str = ".", extra_args=()):
         cmd = [sys.executable, "-m", "paddle_tpu.serving.fleet.replica",
                "--model", model, "--replica-id", replica_id,
                "--queue-depth", "256"]
         if aot_dir:
             cmd += ["--aot-cache", aot_dir]
+        cmd += list(extra_args)
         self.replica_id = replica_id
         self.log_path = os.path.join(log_dir, f"replica_{replica_id}.log")
         self._log = open(self.log_path, "w")
@@ -738,6 +739,269 @@ def leg_fleet_negative(name, ci, log_dir="."):
             if r is not None:
                 r.destroy()
         shutil.rmtree(aot_dir, ignore_errors=True)
+
+
+def _corrupt_metrics_stub():
+    """A 'replica' whose ``/metrics`` endpoints answer 200 with an
+    undecodable body — the telemetry leg's negative control. Returns
+    ``(server, port)``; the caller shuts it down."""
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b"\x00\xffdefinitely{not a metrics body"
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+def _drive_tenant_burst(router, n, n_threads, tenants):
+    """Submit ``n`` standard-priority requests through the router, tagged
+    with ``tenants`` round-robin. Returns caller-side outcome counts
+    (every outcome typed, like :func:`_drive_fleet`)."""
+    from paddle_tpu.serving.fleet import ReplicaLost
+
+    seen = {"completed": 0, "failed": 0, "shed": 0, "deadline": 0,
+            "circuit_open": 0, "stopped": 0, "replica_lost": 0,
+            "other_error": 0}
+    lock = threading.Lock()
+
+    def note(key):
+        with lock:
+            seen[key] += 1
+
+    def submitter(tid):
+        for i in range(tid, n, n_threads):
+            try:
+                router.submit(_mlp_feed(rows=1, seed=i), priority=1,
+                              tenant=tenants[i % len(tenants)])
+                note("completed")
+            except serving.BatchFailed:
+                note("failed")
+            except ReplicaLost:
+                note("replica_lost")
+            except serving.Overloaded:
+                note("shed")
+            except serving.DeadlineExceeded:
+                note("deadline")
+            except serving.CircuitOpen:
+                note("circuit_open")
+            except serving.EngineStopped:
+                note("stopped")
+            except Exception:
+                note("other_error")
+
+    threads = [threading.Thread(target=submitter, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    return threads, seen
+
+
+def leg_fleet_telemetry(name, ci, log_dir="."):
+    """The telemetry-plane gate (docs/OBSERVABILITY.md "Fleet telemetry
+    plane"): 2 replica PROCESSES serving ``/metrics`` + a third target
+    serving a CORRUPT body, scraped by an in-process
+    :class:`FleetAggregator`. Proves, end to end over the wire:
+
+    * fleet p50/p99 assembled from SCRAPED per-replica histograms via
+      the exact bucket-wise merge, count cross-checked against the
+      router's own completed ledger;
+    * SLO burn state flips to ``burning`` under injected stalled-batch
+      faults on one replica (``batch_dispatch`` fault plan) and recovers
+      to ``ok`` once the burn windows drain;
+    * the per-tenant ledger sums EXACTLY to the fleet outcome ledger,
+      outcome by outcome;
+    * at least one exported exemplar ``trace_id`` resolves to a recorded
+      trace (the router-side root span — one trace id across processes);
+    * the corrupt-``/metrics`` target degrades typed: marked stale,
+      ``fleet_scrape_failures_total{kind=corrupt}`` counted, the
+      aggregator keeps scraping/publishing the healthy replicas and its
+      poll thread stays alive (zero crashes).
+    """
+    from paddle_tpu import flags as flags_mod
+    from paddle_tpu import trace
+    from paddle_tpu.serving.fleet import (AggregatorConfig, FleetAggregator,
+                                          FleetRouter, Replica)
+
+    # squeezed burn windows so the ok -> burning -> ok round trip fits a
+    # CI leg; targets/budget stay at defaults (1% budget: one failed
+    # batch flips both windows hot immediately)
+    slo_flags = ["--set-flag", "FLAGS_serving_slo_fast_window_s=2",
+                 "--set-flag", "FLAGS_serving_slo_slow_window_s=6"]
+    tele_args = ["--trace", "--set-flag", "FLAGS_fleet_telemetry=1"]
+    stall_args = ["--set-flag",
+                  "FLAGS_fault_plan=batch_dispatch:2:TimeoutError"]
+    aot_dir = tempfile.mkdtemp(prefix="paddle_tpu_fleet_tele_aot_")
+    saved_overrides = dict(flags_mod._overrides)
+    r0 = r1 = stub = agg = None
+    burn_timeline = []
+
+    def observe_state(agg, t0):
+        snap = agg.snapshot()
+        st = snap["fleet"]["slo_state"]
+        if not burn_timeline or burn_timeline[-1][1] != st:
+            burn_timeline.append((round(time.monotonic() - t0, 2), st))
+        return st, snap
+
+    try:
+        # the aggregator + router run IN PROCESS: they need the plane and
+        # tracing on locally too (exemplar resolution joins the router's
+        # recorded root spans)
+        fluid.set_flags({"FLAGS_fleet_telemetry": 1, "FLAGS_trace": 1})
+        r0 = _ReplicaProc("mlp_tiny", "r0", aot_dir, log_dir,
+                          extra_args=tele_args + slo_flags)
+        r0.wait_ready()
+        r1 = _ReplicaProc("mlp_tiny", "r1", aot_dir, log_dir,
+                          extra_args=tele_args + slo_flags + stall_args)
+        r1.wait_ready()
+        stub, bad_port = _corrupt_metrics_stub()
+
+        router = FleetRouter([Replica("r0", "127.0.0.1", r0.port),
+                              Replica("r1", "127.0.0.1", r1.port)])
+        agg = FleetAggregator(
+            [("r0", f"127.0.0.1:{r0.port}"),
+             ("r1", f"127.0.0.1:{r1.port}"),
+             ("rbad", f"127.0.0.1:{bad_port}")],
+            AggregatorConfig(scrape_interval_s=0.25, scrape_timeout_s=5.0))
+        n = 28 if ci else 80
+        tenants = ("acme", "globex", "initech")
+        t0 = time.monotonic()
+        burning_seen = recovered = False
+        with router:
+            with agg:
+                threads, seen = _drive_tenant_burst(router, n, 4, tenants)
+                # poll while the burst runs: the stalled batches land at
+                # its head, so burning must be OBSERVED inside the fast
+                # window, not reconstructed afterwards
+                while any(t.is_alive() for t in threads):
+                    st, _ = observe_state(agg, t0)
+                    burning_seen = burning_seen or st == "burning"
+                    time.sleep(0.15)
+                for t in threads:
+                    t.join(600)
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    st, _ = observe_state(agg, t0)
+                    burning_seen = burning_seen or st == "burning"
+                    if burning_seen and st == "ok":
+                        recovered = True
+                        break
+                    time.sleep(0.25)
+                agg.poll_now()
+                final = agg.snapshot()
+                thread_alive = (agg._thread is not None
+                                and agg._thread.is_alive())
+            acct = router.accounting()
+        seen["submitted"] = n
+        seen["terminal"] = sum(v for k, v in seen.items()
+                               if k not in ("submitted", "terminal"))
+
+        fleet = final["fleet"]
+        replicas = final["replicas"]
+        merged_count = (fleet["latency"] or {}).get("count", 0)
+        # tenant reconciliation: outcome by outcome, the summed tenant
+        # ledger must equal the scraped fleet outcome ledger exactly
+        tenant_sums = {}
+        for t in fleet["tenants"].values():
+            for o, c in t["outcomes"].items():
+                tenant_sums[o] = tenant_sums.get(o, 0) + c
+        fleet_outcomes = {k: int(v) for k, v in fleet["outcomes"].items()}
+        # exemplar resolution: exported trace ids join the router's
+        # in-process recorded spans (one trace id across processes)
+        exported = set()
+        for rec in (replicas.get("r0"), replicas.get("r1")):
+            for fam in (rec or {}).get("exemplars", {}).values():
+                for child in fam:
+                    for ring in child["buckets"].values():
+                        exported.update(e["trace_id"] for e in ring)
+        recorded = {s.trace_id for s in trace.spans()}
+        resolved = sorted(exported & recorded)
+        rbad = replicas.get("rbad") or {}
+        corrupt_count = monitor.metric_value(
+            "fleet_scrape_failures_total", default=0,
+            replica="rbad", kind="corrupt")
+
+        checks = {
+            "exact_fleet_accounting": bool(acct["exact"]),
+            "every_submit_terminal": seen["terminal"] == seen["submitted"],
+            "no_untyped_errors": seen["other_error"] == 0,
+            "stall_faults_burned_budget": seen["failed"] > 0,
+            "fleet_latency_scraped":
+                fleet["p50"] is not None and fleet["p99"] is not None,
+            "scraped_count_matches_router_ledger":
+                merged_count == acct["completed"] > 0,
+            "scraped_completed_matches_router_ledger":
+                int(fleet_outcomes.get("completed", 0))
+                == acct["completed"],
+            "slo_burning_observed": burning_seen,
+            "slo_recovered": recovered,
+            "tenant_ledger_reconciles":
+                bool(tenant_sums) and tenant_sums == fleet_outcomes,
+            "all_tenants_accounted":
+                set(tenants) <= set(fleet["tenants"]),
+            "exemplar_resolves_to_trace": len(resolved) > 0,
+            "corrupt_target_stale":
+                bool(rbad.get("stale")) and not rbad.get("up")
+                and rbad.get("error") == "corrupt"
+                and rbad.get("consecutive_failures", 0) >= 1,
+            "corrupt_failures_counted": corrupt_count >= 1,
+            "healthy_replicas_kept_publishing":
+                bool(replicas.get("r0", {}).get("up"))
+                and bool(replicas.get("r1", {}).get("up")),
+            "aggregator_thread_survived": thread_alive,
+        }
+        telemetry = {
+            "fleet_p50_s": fleet["p50"], "fleet_p99_s": fleet["p99"],
+            "scraped_latency_count": merged_count,
+            "router_completed": acct["completed"],
+            "fleet_outcomes": fleet_outcomes,
+            "tenants": fleet["tenants"],
+            "slo_timeline": burn_timeline,
+            "slo_state_final": fleet["slo_state"],
+            "exemplars_exported": len(exported),
+            "exemplar_resolved_trace_ids": resolved[:4],
+            "corrupt_scrapes": int(corrupt_count),
+            "scrape_ages_s": {rid: rec.get("scrape_age_s")
+                              for rid, rec in replicas.items()},
+        }
+        return {"name": name, "ok": all(checks.values()), "requests": n,
+                "caller_view": seen, "router_accounting": acct,
+                "checks": checks, "telemetry": telemetry,
+                "why": "fleet p50/p99 from scraped /metrics cross-checked "
+                       "vs the router ledger; SLO burns and recovers "
+                       "under injected stalled batches; tenant ledger "
+                       "reconciles exactly; exemplars resolve to traces; "
+                       "a corrupt /metrics target degrades typed with "
+                       "zero aggregator crashes"}
+    finally:
+        if agg is not None:
+            agg.stop()
+        if stub is not None:
+            stub.shutdown()
+        for r in (r0, r1):
+            if r is not None:
+                r.sigterm()
+        for r in (r0, r1):
+            if r is not None:
+                try:
+                    r.wait_exit(60)
+                except Exception:
+                    pass
+                r.destroy()
+        shutil.rmtree(aot_dir, ignore_errors=True)
+        flags_mod._overrides.clear()
+        flags_mod._overrides.update(saved_overrides)
+        flags_mod._set_epoch += 1
 
 
 # ---------------------------------------------------------------------------
@@ -1333,6 +1597,8 @@ def main(argv=None) -> int:
         else:
             legs.append(leg_fleet("fleet_kill_one_replica", ci,
                                   args.log_dir))
+            legs.append(leg_fleet_telemetry("fleet_telemetry_plane", ci,
+                                            args.log_dir))
         gate_ok = all(l["ok"] for l in legs)
         for l in legs:
             status = "ok" if l["ok"] else "MISS"
@@ -1356,6 +1622,19 @@ def main(argv=None) -> int:
                 print(f"fleet latency: count={lat['count']} "
                       f"p50={lat['p50'] * 1e3:.1f}ms "
                       f"p99={lat['p99'] * 1e3:.1f}ms")
+            tele = l.get("telemetry")
+            if tele:
+                print(f"telemetry: scraped fleet "
+                      f"count={tele['scraped_latency_count']} "
+                      f"p50={(tele['fleet_p50_s'] or 0) * 1e3:.1f}ms "
+                      f"p99={(tele['fleet_p99_s'] or 0) * 1e3:.1f}ms "
+                      f"(router completed={tele['router_completed']}), "
+                      f"tenants={sorted(tele['tenants'])}, "
+                      f"corrupt scrapes={tele['corrupt_scrapes']}, "
+                      f"exemplars resolved="
+                      f"{len(tele['exemplar_resolved_trace_ids'])}")
+                print("slo burn: " + " -> ".join(
+                    f"{st}@{t:.1f}s" for t, st in tele["slo_timeline"]))
         print(f"serving gate ({time.time() - t0:.1f}s) -> "
               f"{'ok' if gate_ok else 'FAIL'}")
         if args.json:
@@ -1364,6 +1643,8 @@ def main(argv=None) -> int:
                     "legs": legs,
                     "warmstart": next((l.get("warmstart") for l in legs
                                        if l.get("warmstart")), None),
+                    "telemetry": next((l.get("telemetry") for l in legs
+                                       if l.get("telemetry")), None),
                     "snapshot": monitor.snapshot(),
                     "check": {"status": "ok" if gate_ok else "fail",
                               "negative_control":
