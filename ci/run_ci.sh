@@ -242,6 +242,36 @@ if ! grep -q -- "-> FAIL" "$FLEET_CHAOS_NEG_LOG"; then
   exit 1
 fi
 
+echo "== fleet control-loop gate (FleetAutoscaler + tenant fair-share: a"
+echo "   hot-tenant flood is shed typed tenant_quota while innocent tenants"
+echo "   keep their SLO, the shed storm burns the SLO budget and the"
+echo "   autoscaler scales OUT a second replica warm through the fleet-shared"
+echo "   AOT cache AND the fleet-shared autotune CostDatabase (faster"
+echo "   time-to-ready than the cold baseline, autotune hits with zero"
+echo "   re-trials), refusals at the max are typed+metered, calm scales back"
+echo "   IN strictly via preemption-drain with an exact exit ledger, and the"
+echo "   floor holds typed at_min_replicas — fleet accounting exact"
+echo "   throughout)"
+JAX_PLATFORMS=cpu python tools/load_check.py --ci --autoscale \
+  --log-dir "${CI_ARTIFACT_DIR:-.}" \
+  --json "${CI_ARTIFACT_DIR:-.}/ci_autoscale_report.json" | tail -14
+echo "== fleet control-loop negative control (no autoscaler, no tenant"
+echo "   quotas: sustained hot pressure goes unanswered and the hot tenant"
+echo "   is never shed typed — the gate must FAIL)"
+AUTOSCALE_NEG_LOG="${CI_ARTIFACT_DIR:-.}/ci_autoscale_negative.log"
+if JAX_PLATFORMS=cpu python tools/load_check.py --ci --autoscale \
+     --negative-control --log-dir "${CI_ARTIFACT_DIR:-.}" \
+     > "$AUTOSCALE_NEG_LOG" 2>&1; then
+  echo "load_check --autoscale did NOT fail without the control loop" >&2
+  exit 1
+fi
+# non-zero exit must be the gate tripping, not the harness crashing
+if ! grep -q -- "-> FAIL" "$AUTOSCALE_NEG_LOG"; then
+  echo "autoscale negative control exited non-zero WITHOUT tripping the gate:" >&2
+  tail -20 "$AUTOSCALE_NEG_LOG" >&2
+  exit 1
+fi
+
 echo "== trace gate (paddle_tpu.trace: every request in exactly one complete"
 echo "   trace, flight-recorder dumps on injected batch fault + watchdog hang,"
 echo "   cost-model FLOPs within 10% of analytic, near-zero off overhead;"

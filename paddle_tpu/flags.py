@@ -273,6 +273,72 @@ _DEFS: Dict[str, tuple] = {
     "serving_slo_slow_window_s": (float, 600.0,
                                   "slow burn-rate window in seconds (the "
                                   "sustained-burn confirmation window)"),
+    # per-tenant quotas + weighted fair share (serving/engine.py;
+    # docs/SERVING.md 'Fleet control loop'). ServingConfig reads these as
+    # its defaults; explicit config fields win.
+    "serving_tenant_fair_share": (bool, False,
+                                  "per-tenant admission fairness: a tenant "
+                                  "holding more than its queue quota is "
+                                  "shed typed Overloaded(reason="
+                                  "tenant_quota), and the dispatcher picks "
+                                  "batches by weighted fair queueing "
+                                  "(DWRR-equivalent stride scheduling) "
+                                  "instead of strict FIFO. Off (default): "
+                                  "admission and dispatch behave exactly "
+                                  "as before"),
+    "serving_tenant_weights": (str, "",
+                               "'tenant:weight,...' fair-share weights "
+                               "(e.g. 'acme:3,globex:1'); unlisted "
+                               "tenants get weight 1. A tenant's queue "
+                               "quota and dispatch share scale with its "
+                               "weight"),
+    "serving_tenant_quota_frac": (float, 0.5,
+                                  "largest fraction of serving_queue_depth "
+                                  "one weight-1 tenant may occupy before "
+                                  "its NEW arrivals are shed typed "
+                                  "Overloaded(reason=tenant_quota); a "
+                                  "tenant with weight w gets w times this "
+                                  "share (capped at the whole queue)"),
+    # fleet autoscaler (serving/fleet/autoscaler.py; docs/SERVING.md
+    # 'Fleet control loop'). AutoscalerConfig reads these as defaults.
+    "serving_autoscale_min_replicas": (int, 1,
+                                       "autoscaler floor: scale-in below "
+                                       "this many replicas is refused "
+                                       "typed at_min_replicas"),
+    "serving_autoscale_max_replicas": (int, 4,
+                                       "autoscaler ceiling: scale-out "
+                                       "above this many replicas is "
+                                       "refused typed at_max_replicas"),
+    "serving_autoscale_interval_s": (float, 1.0,
+                                     "autoscaler control-loop tick "
+                                     "interval in seconds"),
+    "serving_autoscale_cooldown_s": (float, 30.0,
+                                     "minimum seconds between two scale "
+                                     "actions (and from a drain start to "
+                                     "the next action): decisions inside "
+                                     "it are refused typed cooldown — the "
+                                     "anti-flap half of the hysteresis"),
+    "serving_autoscale_hot_sustain_s": (float, 5.0,
+                                        "burn/pressure must be observed "
+                                        "continuously for this long "
+                                        "before a scale-out fires (one "
+                                        "bad tick never scales)"),
+    "serving_autoscale_calm_sustain_s": (float, 30.0,
+                                         "the fleet must be calm (no "
+                                         "burn, no pressure) continuously "
+                                         "for this long before a drain-"
+                                         "based scale-in fires"),
+    "serving_autoscale_max_inflight_spawns": (int, 1,
+                                              "spawns not yet ready the "
+                                              "autoscaler may have in "
+                                              "flight; further scale-outs "
+                                              "are refused typed "
+                                              "spawn_budget_spent"),
+    "serving_autoscale_queue_high": (int, 8,
+                                     "per-replica queue depth the "
+                                     "autoscaler counts as pressure "
+                                     "(alongside degraded mode and open "
+                                     "breaker buckets)"),
     # fleet telemetry plane (serving/fleet/telemetry.py;
     # docs/OBSERVABILITY.md 'Fleet telemetry plane')
     "fleet_telemetry": (bool, False,

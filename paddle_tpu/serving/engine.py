@@ -81,7 +81,7 @@ __all__ = ["ServingConfig", "ServingEngine", "ServingFuture",
            "ServingError", "Overloaded", "CircuitOpen", "BatchFailed",
            "PoisonRequest", "EngineStopped", "DeadlineExceeded",
            "HEALTH_SCHEMA_VERSION", "HEALTH_SCHEMA_KEYS",
-           "DEFAULT_TENANT"]
+           "DEFAULT_TENANT", "parse_tenant_weights"]
 
 logger = logging.getLogger("paddle_tpu.serving")
 
@@ -205,6 +205,12 @@ class ServingConfig:
     slo_error_budget: Optional[float] = None
     slo_fast_window_s: Optional[float] = None
     slo_slow_window_s: Optional[float] = None
+    # per-tenant quotas + weighted fair share (docs/SERVING.md "Fleet
+    # control loop"): off by default — admission/dispatch identical to
+    # the pre-tenant engine unless turned on
+    tenant_fair_share: Optional[bool] = None
+    tenant_weights: Optional[str] = None        # 'tenant:weight,...'
+    tenant_quota_frac: Optional[float] = None
 
     def resolve(self) -> "ServingConfig":
         r = ServingConfig(
@@ -240,6 +246,12 @@ class ServingConfig:
                 self.slo_fast_window_s, "serving_slo_fast_window_s")),
             slo_slow_window_s=float(_flag_default(
                 self.slo_slow_window_s, "serving_slo_slow_window_s")),
+            tenant_fair_share=bool(_flag_default(
+                self.tenant_fair_share, "serving_tenant_fair_share")),
+            tenant_weights=str(_flag_default(
+                self.tenant_weights, "serving_tenant_weights")),
+            tenant_quota_frac=float(_flag_default(
+                self.tenant_quota_frac, "serving_tenant_quota_frac")),
         )
         if r.max_batch < 1:
             raise ValueError(f"serving: max_batch must be >= 1, got "
@@ -247,7 +259,37 @@ class ServingConfig:
         if r.queue_depth < 1:
             raise ValueError(f"serving: queue_depth must be >= 1, got "
                              f"{r.queue_depth}")
+        if not 0.0 < r.tenant_quota_frac <= 1.0:
+            raise ValueError(f"serving: tenant_quota_frac must be in "
+                             f"(0, 1], got {r.tenant_quota_frac}")
+        parse_tenant_weights(r.tenant_weights)  # validate the spec early
         return r
+
+
+def parse_tenant_weights(spec: str) -> Dict[str, float]:
+    """Parse a ``'tenant:weight,...'`` fair-share spec (the
+    ``FLAGS_serving_tenant_weights`` format) into a dict. Unlisted
+    tenants weigh 1. Malformed entries raise ``ValueError`` at config
+    resolve time — never mid-admission."""
+    weights: Dict[str, float] = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, sep, raw = entry.rpartition(":")
+        if not sep or not name:
+            raise ValueError(f"serving: bad tenant weight entry "
+                             f"{entry!r} (want 'tenant:weight')")
+        try:
+            w = float(raw)
+        except ValueError:
+            raise ValueError(f"serving: bad tenant weight {raw!r} "
+                             f"for tenant {name!r}") from None
+        if w <= 0:
+            raise ValueError(f"serving: tenant weight must be > 0, "
+                             f"got {w} for tenant {name!r}")
+        weights[name.strip()] = w
+    return weights
 
 
 # ---------------------------------------------------------------------------
@@ -473,6 +515,17 @@ class ServingEngine:
         # with and without the engine lock held
         self._tenant_lock = _monitor.make_lock("ServingEngine._tenant_lock")
         self._tenant_ledger: Dict[str, dict] = {}
+
+        # weighted fair share (guarded by _lock; docs/SERVING.md "Fleet
+        # control loop"): parsed weight table plus the stride-scheduler
+        # pass values — a tenant's pass advances by rows/weight on every
+        # dispatch, and the anchor request of the next batch comes from
+        # the queued tenant with the smallest pass. Only consulted when
+        # config.tenant_fair_share is on; the table is bounded by
+        # eviction of tenants with nothing queued.
+        self._tenant_weights = parse_tenant_weights(
+            self.config.tenant_weights)
+        self._tenant_pass: Dict[str, float] = {}
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServingEngine":
@@ -716,6 +769,35 @@ class ServingEngine:
                 f"serving: feed fingerprint {req.fp} is quarantined "
                 f"(isolated as a poison request; shed {repeats} time(s) "
                 f"since)", reason="poison_quarantine")
+        if self.config.tenant_fair_share:
+            # per-tenant queue quota BEFORE the global depth bound: a hot
+            # tenant is shed typed tenant_quota while the queue still has
+            # room for everyone else — the under-share tenants keep their
+            # SLO. The queued count is an O(queue) scan, deliberately:
+            # there is no per-tenant counter to drift out of sync with
+            # the queue across shed/sweep/crash-guard mutations, and the
+            # queue is bounded by config.queue_depth.
+            quota = self._tenant_quota(req.tenant)
+            queued = sum(1 for r in self._queue if r.tenant == req.tenant)
+            if queued >= quota:
+                self._shed_locked("tenant_quota", now)
+                # attribute the quota shed in the tenant ledger (the
+                # fleet_top share/shed table); lock order engine _lock ->
+                # _tenant_lock matches the settle paths
+                with self._tenant_lock:
+                    t = self._tenant_ledger.setdefault(
+                        req.tenant, {"outcomes": {}, "occupancy_s": 0.0})
+                    t["quota_sheds"] = t.get("quota_sheds", 0) + 1
+                if _monitor.enabled():
+                    _monitor.counter(
+                        "serving_tenant_quota_sheds_total",
+                        "admissions shed by per-tenant queue quota"
+                    ).labels(tenant=req.tenant).inc()
+                raise Overloaded(
+                    f"serving: tenant '{req.tenant}' is over its "
+                    f"fair-share queue quota ({queued} >= {quota} of "
+                    f"{self.config.queue_depth} slots)",
+                    reason="tenant_quota")
         if len(self._queue) >= self.config.queue_depth:
             self._shed_locked("queue_full", now)
             raise Overloaded(
@@ -883,18 +965,25 @@ class ServingEngine:
     def _take_batch_locked(self, now: float) -> List[_Request]:
         if not self._queue:
             return []
-        sig = self._queue[0].sig
+        # fair share picks the batch ANCHOR (the request guaranteed a
+        # slot): the head of the queue normally, the first queued request
+        # of the lowest-pass tenant under weighted fair queueing. The
+        # rest of the batch still coalesces same-signature requests in
+        # FIFO order — fairness decides whose turn it is, not the
+        # bucketing.
+        anchor = (self._fair_anchor_locked()
+                  if self.config.tenant_fair_share else self._queue[0])
+        sig = anchor.sig
         cap = self._cur_max_batch
-        batch, rows, rest = [], 0, []
+        # the anchor rides even when degradation shrank the ceiling below
+        # its row count: dispatched ALONE at its natural bucket — the
+        # degraded cap bounds coalescing, it must never strand an
+        # admitted request without a terminal outcome
+        batch, rows, rest = [anchor], anchor.nrows, []
         for r in self._queue:
+            if r is anchor:
+                continue
             if r.sig == sig and rows + r.nrows <= cap:
-                batch.append(r)
-                rows += r.nrows
-            elif r.sig == sig and not batch and r.nrows > cap:
-                # admitted before degradation shrank the ceiling below its
-                # row count: dispatch it ALONE at its natural bucket — the
-                # degraded cap bounds coalescing, it must never strand an
-                # admitted request without a terminal outcome
                 batch.append(r)
                 rows += r.nrows
             else:
@@ -923,7 +1012,52 @@ class ServingEngine:
                 self._windowed = False
         self._queue[:] = rest
         self._gauge_depth_locked()
+        if self.config.tenant_fair_share:
+            self._fair_charge_locked(batch)
         return batch
+
+    # -- weighted fair share (docs/SERVING.md "Fleet control loop") ------
+    def _tenant_weight(self, tenant: str) -> float:
+        return self._tenant_weights.get(tenant, 1.0)
+
+    def _tenant_quota(self, tenant: str) -> int:
+        """Queue slots tenant may hold: ``depth * quota_frac * weight``,
+        at least 1, at most the whole queue."""
+        depth = self.config.queue_depth
+        quota = int(depth * self.config.tenant_quota_frac
+                    * self._tenant_weight(tenant))
+        return max(1, min(depth, quota))
+
+    def _fair_anchor_locked(self) -> "_Request":
+        """Stride scheduling (DWRR-equivalent): the next batch is
+        anchored on the first queued request of the tenant with the
+        smallest pass value. Passes advance by ``rows / weight`` at
+        dispatch, so over time each tenant's dispatched rows converge to
+        its weight share; a tenant with nothing queued is dropped from
+        the table and re-enters at the current minimum pass (no banked
+        credit, no starvation)."""
+        first: Dict[str, _Request] = {}
+        for r in self._queue:
+            if r.tenant not in first:
+                first[r.tenant] = r
+        if len(first) <= 1:
+            return self._queue[0]
+        for t in list(self._tenant_pass):
+            if t not in first:
+                del self._tenant_pass[t]
+        floor = min(self._tenant_pass.values()) if self._tenant_pass \
+            else 0.0
+        for t in first:
+            self._tenant_pass.setdefault(t, floor)
+        best = min(first, key=lambda t: (self._tenant_pass[t],
+                                         first[t].seq))
+        return first[best]
+
+    def _fair_charge_locked(self, batch: List["_Request"]) -> None:
+        for r in batch:
+            self._tenant_pass[r.tenant] = (
+                self._tenant_pass.get(r.tenant, 0.0)
+                + r.nrows / self._tenant_weight(r.tenant))
 
     def _run_batch(self, batch: List[_Request], depth: int = 0,
                    ctx: Optional[dict] = None) -> None:
@@ -1439,9 +1573,18 @@ class ServingEngine:
         counts sum exactly to ``accounting()``'s terminal counts — the
         fleet CI gate's tenant-reconciliation invariant."""
         with self._tenant_lock:
-            return {t: {"outcomes": dict(v["outcomes"]),
-                        "occupancy_s": v["occupancy_s"]}
-                    for t, v in self._tenant_ledger.items()}
+            out = {t: {"outcomes": dict(v["outcomes"]),
+                       "occupancy_s": v["occupancy_s"],
+                       "quota_sheds": v.get("quota_sheds", 0)}
+                   for t, v in self._tenant_ledger.items()}
+        if self.config.tenant_fair_share:
+            # additive keys (documented minor change): the tenant's
+            # configured share so the shed counts are auditable against
+            # the policy that produced them
+            for t, rec in out.items():
+                rec["weight"] = self._tenant_weight(t)
+                rec["quota"] = self._tenant_quota(t)
+        return out
 
     def slo_state(self) -> dict:
         """The SLO burn tracker's serialized state (the health payload's

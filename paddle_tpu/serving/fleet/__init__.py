@@ -28,6 +28,7 @@ tier" has the architecture, wire schema and routing policy.
 """
 from __future__ import annotations
 
+from .autoscaler import AutoscalerConfig, FleetAutoscaler
 from .frontend import FrontendConfig, ServingFrontend
 from .router import FleetRouter, Replica, RouterConfig
 from .supervisor import (ReplicaCrashLoop, ReplicaSupervisor,
@@ -43,5 +44,5 @@ __all__ = [
     "SupervisedReplica", "ReplicaCrashLoop", "ReplicaLost", "WireError",
     "WIRE_SCHEMA_VERSION", "TRACE_HEADER", "SLO_CLASSES",
     "FleetAggregator", "AggregatorConfig", "metrics_json",
-    "METRICS_SCHEMA_VERSION",
+    "METRICS_SCHEMA_VERSION", "FleetAutoscaler", "AutoscalerConfig",
 ]

@@ -90,6 +90,12 @@ class SupervisorConfig:
     exit_grace_s: float = 30.0     # SIGTERM drain wait before SIGKILL
     seed: int = 0
     restart: bool = True
+    # fleet-shared flags, passed to EVERY spawned replica as
+    # ``--set-flag name=value`` pairs: how one autotune CostDatabase
+    # (FLAGS_autotune_db — flock-merge safe) and one AOT cache warm the
+    # whole fleet, so a scale-out replica compiles straight to
+    # best-known configs instead of re-measuring
+    shared_flags: Optional[Dict[str, str]] = None
 
 
 class SupervisedReplica:
@@ -305,6 +311,11 @@ class ReplicaSupervisor:
                "--host", h.host, "--port", "0"]
         if h.aot_dir:
             cmd += ["--aot-cache", h.aot_dir]
+        # fleet-shared flags ride every spawn, BEFORE the per-replica
+        # extras so a replica-specific --set-flag can still override
+        for name in sorted(self.config.shared_flags or {}):
+            cmd += ["--set-flag",
+                    f"{name}={self.config.shared_flags[name]}"]
         cmd += h.extra_args
         if h.spawns == 0:
             cmd += h.initial_extra_args

@@ -442,6 +442,8 @@ class FleetAggregator:
                 for o, n in (t.get("outcomes") or {}).items():
                     agg["outcomes"][o] = agg["outcomes"].get(o, 0) + n
                 agg["occupancy_s"] += float(t.get("occupancy_s") or 0.0)
+                agg["quota_sheds"] = (agg.get("quota_sheds", 0)
+                                      + int(t.get("quota_sheds") or 0))
             worst = max(worst, _slo_index(r.get("slo")))
         return {"latency": merged, "outcomes": outcomes,
                 "tenants": tenants, "slo_index": worst,

@@ -1,0 +1,507 @@
+"""FleetAutoscaler: the control loop closing ROADMAP item 5.
+
+Hysteresis, cooldown and every typed refusal are pinned with an
+INJECTED clock (the SloBurnTracker idiom) against fake sensors and a
+fake actuator — no sleeps, no processes. The scale-in race (drain a
+replica mid-burst) runs against two REAL in-process engines behind a
+real router, with a supervisor shim whose drain() is the engine's
+graceful drain-stop: everything admitted on the victim completes,
+nothing new lands on it, the fleet ledger stays exact, and a
+concurrent scale-out decision during the drain is refused typed
+``cooldown``. The multi-process version is the CI gate
+(``tools/load_check.py --autoscale``)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor, serving
+from paddle_tpu.serving.fleet import (AutoscalerConfig, FleetAutoscaler,
+                                      FleetRouter, Replica,
+                                      ServingFrontend)
+from paddle_tpu.serving.fleet.autoscaler import _worst
+
+
+@pytest.fixture(autouse=True)
+def _flags_reset():
+    from paddle_tpu import flags as flags_mod
+
+    snap = dict(flags_mod._overrides)
+    yield
+    flags_mod._overrides.clear()
+    flags_mod._overrides.update(snap)
+    flags_mod._set_epoch += 1
+
+
+# ---------------------------------------------------------------------------
+# fakes: sensors + actuator the loop is pinned against
+# ---------------------------------------------------------------------------
+
+class FakeSupervisor:
+    """Duck-typed actuator: records every act; tests move states."""
+
+    def __init__(self, **states):
+        self.states = dict(states)     # rid -> supervisor state
+        self.added = []
+        self.drained = []
+        self.router = None
+
+    def status(self):
+        return {rid: {"state": s} for rid, s in self.states.items()}
+
+    def add_replica(self, replica_id, model="mlp_tiny", aot_dir="",
+                    extra_args=()):
+        self.added.append(replica_id)
+        self.states[replica_id] = "spawning"
+
+    def drain(self, replica_id):
+        self.drained.append(replica_id)
+        # the real supervisor keeps the handle live until the process
+        # exits; tests retire it explicitly
+
+
+class FakeReplicaSensor:
+    def __init__(self, replica_id, **snap):
+        self.replica_id = replica_id
+        self.snap = {"ok": True, "ready": True, "queue_depth": 0,
+                     "degraded": False, "open_buckets": 0,
+                     "slo_state": "ok", **snap}
+
+    def snapshot(self):
+        return dict(self.snap)
+
+
+class FakeRouter:
+    def __init__(self, *sensors):
+        self.replicas = list(sensors)
+
+
+def _cfg(**kw):
+    base = dict(min_replicas=1, max_replicas=3, interval_s=0.01,
+                cooldown_s=10.0, hot_sustain_s=2.0, calm_sustain_s=5.0,
+                max_inflight_spawns=1, queue_high=4)
+    base.update(kw)
+    return AutoscalerConfig(**base)
+
+
+def _loop(**kw):
+    """(autoscaler, supervisor, sensor, clock) with one ready replica."""
+    clk = [0.0]
+    sup = FakeSupervisor(r0="ready")
+    sensor = FakeReplicaSensor("r0")
+    auto = FleetAutoscaler(sup, router=FakeRouter(sensor),
+                           config=_cfg(**kw), _now=lambda: clk[0])
+    return auto, sup, sensor, clk
+
+
+# ---------------------------------------------------------------------------
+# hysteresis: sustained signals only, no flap
+# ---------------------------------------------------------------------------
+
+def test_scale_out_needs_sustained_pressure_not_one_bad_tick():
+    auto, sup, sensor, clk = _loop()
+    sensor.snap["queue_depth"] = 9          # pressure
+    assert auto.tick()["action"] == "hold"  # hot but not sustained
+    clk[0] = 1.0
+    assert auto.tick()["action"] == "hold"
+    clk[0] = 2.5                            # past hot_sustain_s=2
+    d = auto.tick()
+    assert d["action"] == "scale_out" and "pressure" in d["reason"]
+    assert sup.added == ["as1"]
+
+
+def test_pressure_blip_resets_the_sustain_clock():
+    auto, sup, sensor, clk = _loop()
+    sensor.snap["queue_depth"] = 9
+    auto.tick()
+    clk[0] = 1.5
+    sensor.snap["queue_depth"] = 0          # blip over: calm tick
+    auto.tick()
+    sensor.snap["queue_depth"] = 9          # hot again — clock restarts
+    clk[0] = 3.0
+    auto.tick()
+    clk[0] = 4.9                            # 1.4s of heat only
+    assert auto.tick()["action"] == "hold"
+    assert sup.added == []
+
+
+def test_oscillating_signal_never_scales():
+    """Flap input, no flap output: a signal alternating faster than
+    either sustain window produces holds forever."""
+    auto, sup, sensor, clk = _loop()
+    for i in range(40):
+        clk[0] = i * 0.5
+        sensor.snap["queue_depth"] = 9 if i % 2 else 0
+        assert auto.tick()["action"] == "hold"
+    assert sup.added == [] and sup.drained == []
+
+
+def test_slo_burn_is_a_scale_out_signal():
+    auto, sup, sensor, clk = _loop()
+    sensor.snap["slo_state"] = "burning"
+    auto.tick()
+    clk[0] = 2.5
+    d = auto.tick()
+    assert d["action"] == "scale_out" and d["reason"] == "slo_burn"
+
+
+def test_degraded_and_open_buckets_are_pressure():
+    for key, val in (("degraded", True), ("open_buckets", 2)):
+        auto, sup, sensor, clk = _loop()
+        sensor.snap[key] = val
+        auto.tick()
+        clk[0] = 2.5
+        assert auto.tick()["action"] == "scale_out"
+
+
+# ---------------------------------------------------------------------------
+# typed refusals — a decision is never silent
+# ---------------------------------------------------------------------------
+
+def _hot_sustained(auto, sensor, clk, t0=0.0):
+    sensor.snap["queue_depth"] = 9
+    clk[0] = t0
+    auto.tick()
+    clk[0] = t0 + 2.5
+
+
+def test_refuse_at_max_replicas_typed_and_metered():
+    auto, sup, sensor, clk = _loop(max_replicas=1)
+    before = monitor.metric_value("autoscaler_decisions_total", 0.0,
+                                  action="refuse_scale_out",
+                                  reason="at_max_replicas")
+    _hot_sustained(auto, sensor, clk)
+    d = auto.tick()
+    assert d["action"] == "refuse_scale_out"
+    assert d["reason"] == "at_max_replicas"
+    assert sup.added == []
+    after = monitor.metric_value("autoscaler_decisions_total", 0.0,
+                                 action="refuse_scale_out",
+                                 reason="at_max_replicas")
+    assert after == before + 1
+
+
+def test_refuse_spawn_budget_spent_while_spawn_in_flight():
+    auto, sup, sensor, clk = _loop(cooldown_s=1.0)
+    _hot_sustained(auto, sensor, clk)
+    assert auto.tick()["action"] == "scale_out"     # as1 now spawning
+    clk[0] = 10.0                                   # cooldown long over
+    d = auto.tick()
+    assert d["action"] == "refuse_scale_out"
+    assert d["reason"] == "spawn_budget_spent"
+    sup.states["as1"] = "ready"                     # spawn lands
+    clk[0] = 12.0
+    assert auto.tick()["action"] == "scale_out"     # budget freed
+    assert sup.added == ["as1", "as2"]
+
+
+def test_refuse_cooldown_after_scale_out():
+    auto, sup, sensor, clk = _loop()
+    _hot_sustained(auto, sensor, clk)
+    auto.tick()
+    sup.states["as1"] = "ready"
+    clk[0] = 5.0                                    # inside cooldown 10s
+    d = auto.tick()
+    assert d["action"] == "refuse_scale_out" and d["reason"] == "cooldown"
+    clk[0] = 13.0                                   # cooldown elapsed
+    assert auto.tick()["action"] == "scale_out"
+
+
+def test_refuse_at_min_replicas_on_calm_floor():
+    auto, sup, sensor, clk = _loop()
+    auto.tick()                                     # calm clock starts
+    clk[0] = 6.0                                    # calm > calm_sustain
+    d = auto.tick()
+    assert d["action"] == "refuse_scale_in"
+    assert d["reason"] == "at_min_replicas"
+    assert sup.drained == []
+
+
+def test_scale_in_drains_the_lifo_autoscaler_spawn():
+    auto, sup, sensor, clk = _loop()
+    _hot_sustained(auto, sensor, clk)
+    auto.tick()                                     # spawn as1
+    sup.states["as1"] = "ready"
+    sensor.snap["queue_depth"] = 0                  # calm
+    clk[0] = 20.0
+    auto.tick()                                     # calm clock starts
+    clk[0] = 26.0                                   # calm 6s > 5s sustain
+    d = auto.tick()
+    assert d["action"] == "scale_in" and d["replica"] == "as1"
+    assert sup.drained == ["as1"]
+
+
+def test_drain_in_flight_refuses_concurrent_scale_out():
+    """The scale-in race, unit form: while the victim drains, a hot
+    signal must NOT scale out — typed cooldown until fully retired."""
+    auto, sup, sensor, clk = _loop(cooldown_s=1.0)
+    _hot_sustained(auto, sensor, clk)
+    auto.tick()
+    sup.states["as1"] = "ready"
+    sensor.snap["queue_depth"] = 0
+    clk[0] = 20.0
+    auto.tick()
+    clk[0] = 26.0
+    assert auto.tick()["action"] == "scale_in"      # as1 draining
+    sensor.snap["queue_depth"] = 9                  # burst returns NOW
+    clk[0] = 27.0
+    auto.tick()
+    clk[0] = 30.0                                   # hot sustained, and
+    d = auto.tick()                                 # cooldown_s=1 passed
+    assert d["action"] == "refuse_scale_out" and d["reason"] == "cooldown"
+    assert "drain" in d["detail"]
+    sup.states["as1"] = "retired"                   # drain completes
+    clk[0] = 31.0
+    assert auto.tick()["action"] == "scale_out"     # loop breathes again
+    assert sup.added == ["as1", "as2"]
+
+
+def test_audit_coalesces_repeated_refusals():
+    auto, sup, sensor, clk = _loop(max_replicas=1)
+    _hot_sustained(auto, sensor, clk)
+    for i in range(20):
+        clk[0] = 3.0 + i * 0.1
+        auto.tick()
+    audit = auto.status()["audit"]
+    refusals = [e for e in audit if e["action"] == "refuse_scale_out"]
+    assert len(refusals) == 1 and refusals[0]["count"] == 20
+
+
+def test_status_carries_sense_and_last_decision():
+    auto, sup, sensor, clk = _loop()
+    _hot_sustained(auto, sensor, clk)
+    auto.tick()
+    st = auto.status()
+    assert st["sense"]["hot"] and st["sense"]["replicas"] == 1
+    assert st["last_decision"]["action"] == "scale_out"
+    assert st["spawned"] == ["as1"]
+
+
+def test_config_validation_is_typed():
+    with pytest.raises(ValueError):
+        AutoscalerConfig(min_replicas=2, max_replicas=1).resolve()
+    with pytest.raises(ValueError):
+        _cfg(max_inflight_spawns=0).resolve()
+
+
+def test_config_resolves_from_flags():
+    fluid.set_flags({"FLAGS_serving_autoscale_max_replicas": 7,
+                     "FLAGS_serving_autoscale_cooldown_s": 3.5})
+    c = AutoscalerConfig().resolve()
+    assert c.max_replicas == 7 and c.cooldown_s == 3.5
+
+
+def test_worst_state_merge_order():
+    assert _worst("ok", "burning") == "burning"
+    assert _worst("warning", "ok") == "warning"
+    assert _worst(None, "ok") == "ok"
+    assert _worst(None, None) == "unknown"
+
+
+def test_fleet_top_renders_autoscaler_and_tenant_table():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_top", os.path.join(os.path.dirname(__file__), "..",
+                                  "tools", "fleet_top.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    snapshot = {
+        "replicas": {"r0": {
+            "up": True, "stale": False, "scrape_age_s": 0.1,
+            "queue_depth": 2, "latency": {"p50": 0.01, "p99": 0.02},
+            "slo": {"state": "ok",
+                    "classes": {"interactive": {"state": "burning"},
+                                "batch": {"state": "ok"}}},
+            "rates": {}, "error": None}},
+        "fleet": {"p50": 0.01, "p99": 0.02, "slo_state": "burning",
+                  "outcomes": {"completed": 10},
+                  "tenants": {"acme": {"outcomes": {"completed": 7,
+                                                    "shed": 3},
+                                       "quota_sheds": 3,
+                                       "occupancy_s": 1.5}}},
+    }
+    auto, sup, sensor, clk = _loop()
+    _hot_sustained(auto, sensor, clk)
+    auto.tick()
+    text = mod.render(snapshot, "12:00:00", autoscaler=auto.status())
+    assert "interactive=burning" in text
+    assert "autoscaler: replicas 1" in text
+    assert "scale_out" in text
+    assert "QUOTA_SHED" in text and "acme" in text
+    # and the scrape-only CLI path still renders without an autoscaler
+    assert "acme" in mod.render(snapshot, "12:00:00")
+
+
+# ---------------------------------------------------------------------------
+# the scale-in race against REAL engines (satellite regression test)
+# ---------------------------------------------------------------------------
+
+def _build_infer(hidden=4, in_dim=13):
+    import paddle_tpu.unique_name as un
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[in_dim], dtype="float32")
+            pred = fluid.layers.fc(x, hidden, act="softmax")
+        infer = main.clone(for_test=True)
+    return infer, startup, pred.name
+
+
+def _engine(**cfg_kw):
+    infer, startup, pred = _build_infer()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    cfg = serving.ServingConfig(max_batch=cfg_kw.pop("max_batch", 4),
+                                **cfg_kw)
+    return serving.ServingEngine(infer, feed_names=["x"],
+                                 fetch_list=[pred], scope=scope,
+                                 executor=exe, config=cfg)
+
+
+def _feed(rows=1, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.rand(rows, 13).astype(np.float32)}
+
+
+class EngineDrainSupervisor:
+    """Supervisor shim over in-process engines: drain() IS the engine's
+    graceful drain-stop (the preemption path), run on its own thread
+    exactly like the real supervisor's SIGTERM."""
+
+    def __init__(self, engines):
+        self.engines = dict(engines)    # rid -> engine
+        self.states = {rid: "ready" for rid in self.engines}
+        self.added = []
+        self.threads = []
+
+    def status(self):
+        return {rid: {"state": s} for rid, s in self.states.items()}
+
+    def add_replica(self, replica_id, **kw):
+        self.added.append(replica_id)
+        self.states[replica_id] = "spawning"
+
+    def drain(self, replica_id):
+        def _drain():
+            self.engines[replica_id].stop(drain=True)
+            self.states[replica_id] = "retired"
+
+        t = threading.Thread(target=_drain, daemon=True)
+        t.start()
+        self.threads.append(t)
+
+
+@pytest.fixture()
+def fleet2():
+    engines, fronts = [], []
+    for i in range(2):
+        eng = _engine(batch_window_s=0.005, queue_depth=64)
+        eng.warm_up()
+        eng.start()
+        fe = ServingFrontend(eng, replica_id=f"r{i}")
+        fe.start()
+        engines.append(eng)
+        fronts.append(fe)
+    router = FleetRouter([Replica(f"r{i}", "127.0.0.1", fe.port)
+                          for i, fe in enumerate(fronts)])
+    router.poll_now()
+    yield router, engines, fronts
+    router.stop()
+    for fe in fronts:
+        fe.stop(wait_inflight_s=2.0)
+    for eng in engines:
+        if not eng._stopped:
+            eng.stop(drain=False)
+
+
+def test_scale_in_mid_burst_drains_clean_and_refuses_concurrent_scale_out(
+        fleet2):
+    router, engines, fronts = fleet2
+    sup = EngineDrainSupervisor({"r0": engines[0], "r1": engines[1]})
+    clk = [0.0]
+    auto = FleetAutoscaler(
+        sup, router=router,
+        config=_cfg(min_replicas=1, calm_sustain_s=1.0, cooldown_s=0.5),
+        _now=lambda: clk[0])
+
+    # a burst is in flight while the loop decides to scale in
+    stop_burst = threading.Event()
+    errors = []
+
+    def _burst(seed):
+        i = 0
+        while not stop_burst.is_set():
+            try:
+                router.submit(_feed(seed=seed * 1000 + i))
+            except serving.ServingError:
+                pass   # typed sheds are legal under burst
+            except Exception as e:   # noqa: BLE001 — fail the test
+                errors.append(e)
+            i += 1
+
+    threads = [threading.Thread(target=_burst, args=(s,), daemon=True)
+               for s in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)                     # requests in flight everywhere
+
+    auto.tick()                         # calm clock starts (snapshots
+    clk[0] = 1.5                        # predate the burst)
+    d = auto.tick()
+    assert d["action"] == "scale_in" and d["replica"] == "r1"
+
+    # concurrent scale-out decision during the drain: typed cooldown
+    clk[0] = 4.0   # cooldown_s long passed; only the drain holds it
+    for rep in router.replicas:         # force a sustained-hot signal
+        if rep.replica_id == "r0":
+            rep._update({**rep.snapshot(), "queue_depth": 99})
+    auto.tick()
+    clk[0] = 7.0
+    d = auto.tick()
+    assert d["action"] == "refuse_scale_out"
+    assert d["reason"] == "cooldown" and "drain" in d["detail"]
+    assert sup.added == []
+
+    # drain completes: victim finished everything it admitted
+    for t in sup.threads:
+        t.join(30.0)
+    assert sup.states["r1"] == "retired"
+    stop_burst.set()
+    for t in threads:
+        t.join(10.0)
+    assert not errors
+
+    victim = engines[1].accounting()
+    assert victim["exact"] and victim["pending"] == 0
+    assert victim["completed"] > 0 and victim["failed"] == 0
+
+    # nothing new lands on the drained replica
+    router.poll_now()
+    before = engines[1].accounting()["submitted"]
+    for i in range(5):
+        router.submit(_feed(seed=9000 + i))
+    assert engines[1].accounting()["submitted"] == before
+
+    # the fleet ledger stays exact through the whole race
+    acct = router.accounting()
+    assert acct["exact"]
+    assert acct["replica_lost"] == 0
+
+    # and once the victim is retired, the loop can scale out again
+    # (re-force the hot signal: the post-drain poll refreshed snapshots)
+    for rep in router.replicas:
+        if rep.replica_id == "r0":
+            rep._update({**rep.snapshot(), "queue_depth": 99})
+    clk[0] = 8.0
+    decisions = [auto.tick()]
+    clk[0] = 11.0
+    decisions.append(auto.tick())
+    assert any(d["action"] == "scale_out" for d in decisions)
+    assert sup.added == ["as1"]

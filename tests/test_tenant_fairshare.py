@@ -1,0 +1,263 @@
+"""Per-tenant quotas + weighted fair-share admission (docs/SERVING.md
+"Fleet control loop").
+
+The contract under test: with ``tenant_fair_share`` ON, a tenant over
+its queue quota is shed typed ``Overloaded(reason="tenant_quota")``
+while under-share tenants keep admitting; dispatch picks batch anchors
+by stride scheduling (dispatched rows converge to the weight share);
+and every shed reconciles exactly in the per-tenant ledger. With the
+flag OFF (the default), admission and dispatch are bit-identical to the
+pre-tenant engine — the whole feature is invisible."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor, serving
+from paddle_tpu.resilience import fault_plan_guard
+from paddle_tpu.serving.engine import parse_tenant_weights
+from paddle_tpu.serving.fleet import wire
+
+
+@pytest.fixture(autouse=True)
+def _flags_and_plan_reset():
+    from paddle_tpu import flags as flags_mod
+    from paddle_tpu.resilience import faults
+
+    snap = dict(flags_mod._overrides)
+    yield
+    flags_mod._overrides.clear()
+    flags_mod._overrides.update(snap)
+    faults.clear_plan()
+
+
+def _build_infer(hidden=4, in_dim=13):
+    import paddle_tpu.unique_name as un
+
+    with un.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[in_dim], dtype="float32")
+            pred = fluid.layers.fc(x, hidden, act="softmax")
+        infer = main.clone(for_test=True)
+    return infer, startup, pred.name
+
+
+def _engine(**cfg_kw):
+    infer, startup, pred = _build_infer()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    cfg = serving.ServingConfig(max_batch=cfg_kw.pop("max_batch", 4),
+                                **cfg_kw)
+    return serving.ServingEngine(infer, feed_names=["x"], fetch_list=[pred],
+                                 scope=scope, executor=exe, config=cfg)
+
+
+def _feed(rows=1, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.rand(rows, 13).astype(np.float32)}
+
+
+def _hang_dispatcher():
+    fluid.set_flags({"FLAGS_step_timeout_s": 2.0,
+                     "FLAGS_watchdog_hard_exit": 0})
+    return fault_plan_guard("hang:@1:hang")
+
+
+def _wait_queue_empty(eng, timeout=10.0):
+    import time
+
+    until = time.monotonic() + timeout
+    while time.monotonic() < until:
+        if not eng._queue:
+            return
+        time.sleep(0.01)
+    raise AssertionError("dispatcher never drained the queue")
+
+
+# ---------------------------------------------------------------------------
+# the weights spec
+# ---------------------------------------------------------------------------
+
+def test_parse_tenant_weights():
+    assert parse_tenant_weights("") == {}
+    assert parse_tenant_weights("acme:3,globex:1.5") == {"acme": 3.0,
+                                                         "globex": 1.5}
+    assert parse_tenant_weights(" acme : 2 ,") == {"acme": 2.0}
+    for bad in ("acme", "acme:zero", "acme:0", ":-1", "acme:-2"):
+        with pytest.raises(ValueError):
+            parse_tenant_weights(bad)
+
+
+def test_weights_validated_at_config_resolve_not_mid_admission():
+    with pytest.raises(ValueError):
+        serving.ServingConfig(tenant_weights="oops").resolve()
+    with pytest.raises(ValueError):
+        serving.ServingConfig(tenant_quota_frac=0.0).resolve()
+
+
+def test_config_resolves_from_flags():
+    fluid.set_flags({"FLAGS_serving_tenant_fair_share": 1,
+                     "FLAGS_serving_tenant_weights": "acme:2",
+                     "FLAGS_serving_tenant_quota_frac": 0.25})
+    c = serving.ServingConfig().resolve()
+    assert c.tenant_fair_share is True
+    assert c.tenant_weights == "acme:2" and c.tenant_quota_frac == 0.25
+
+
+# ---------------------------------------------------------------------------
+# per-tenant queue quota
+# ---------------------------------------------------------------------------
+
+def test_hot_tenant_shed_typed_tenant_quota_while_others_admit():
+    eng = _engine(max_batch=1, queue_depth=8, batch_window_s=0.0,
+                  tenant_fair_share=True, tenant_quota_frac=0.25)
+    eng.warm_up()
+    futs = []
+    with eng, _hang_dispatcher():
+        futs.append(eng.submit(_feed(), tenant="hog"))   # dispatched, hangs
+        _wait_queue_empty(eng)
+        # quota = max(1, int(8 * 0.25)) = 2 queued slots for weight 1
+        futs += [eng.submit(_feed(seed=i), tenant="hog") for i in range(2)]
+        with pytest.raises(serving.Overloaded) as ei:
+            eng.submit(_feed(), tenant="hog")
+        assert ei.value.reason == "tenant_quota"
+        assert "hog" in str(ei.value)
+        # the under-share tenant admits into the SAME queue right after
+        futs.append(eng.submit(_feed(), tenant="small"))
+        for f in futs:
+            f.exception(timeout=60)
+    acct = eng.accounting()
+    assert acct["exact"] and acct["shed"] == 1
+    tenants = eng.tenant_accounting()
+    assert tenants["hog"]["quota_sheds"] == 1
+    assert tenants["hog"]["outcomes"]["shed"] == 1
+    assert tenants["small"].get("quota_sheds", 0) == 0
+    assert monitor.metric_value("serving_tenant_quota_sheds_total", 0.0,
+                                tenant="hog") >= 1
+    assert monitor.metric_value("serving_shed_total", 0.0,
+                                reason="tenant_quota") >= 1
+
+
+def test_weighted_tenant_gets_a_larger_quota():
+    eng = _engine(max_batch=1, queue_depth=8, batch_window_s=0.0,
+                  tenant_fair_share=True, tenant_quota_frac=0.25,
+                  tenant_weights="vip:2")
+    eng.warm_up()
+    futs = []
+    with eng, _hang_dispatcher():
+        futs.append(eng.submit(_feed(), tenant="vip"))
+        _wait_queue_empty(eng)
+        # weight 2 doubles the quota: 4 queued slots instead of 2
+        futs += [eng.submit(_feed(seed=i), tenant="vip") for i in range(4)]
+        with pytest.raises(serving.Overloaded) as ei:
+            eng.submit(_feed(), tenant="vip")
+        assert ei.value.reason == "tenant_quota"
+        for f in futs:
+            f.exception(timeout=60)
+    tenants = eng.tenant_accounting()
+    assert tenants["vip"]["weight"] == 2.0 and tenants["vip"]["quota"] == 4
+
+
+def test_fair_share_off_is_the_pre_tenant_engine():
+    """Default config: no tenant ever sees tenant_quota — the queue_full
+    bound is the only depth shed, exactly as before this feature."""
+    eng = _engine(max_batch=1, queue_depth=2, batch_window_s=0.0)
+    assert eng.config.tenant_fair_share is False
+    eng.warm_up()
+    futs = []
+    with eng, _hang_dispatcher():
+        futs.append(eng.submit(_feed(), tenant="hog"))
+        _wait_queue_empty(eng)
+        futs += [eng.submit(_feed(seed=i), tenant="hog") for i in range(2)]
+        with pytest.raises(serving.Overloaded) as ei:
+            eng.submit(_feed(), tenant="hog")
+        assert ei.value.reason == "queue_full"
+        for f in futs:
+            f.exception(timeout=60)
+    assert eng.accounting()["exact"]
+
+
+def test_tenant_quota_reason_travels_the_wire():
+    e = serving.Overloaded("over share", reason="tenant_quota")
+    back = wire.error_from_body(wire.error_body(e))
+    assert isinstance(back, serving.Overloaded)
+    assert back.reason == "tenant_quota"
+    assert wire.status_for(e) == 429   # unadmitted: safe sibling retry
+
+
+# ---------------------------------------------------------------------------
+# stride-scheduled dispatch (DWRR-equivalent)
+# ---------------------------------------------------------------------------
+
+def test_dispatch_interleaves_tenants_instead_of_fifo():
+    """6 hog requests queued ahead of 2 small ones: strict FIFO would
+    settle every hog first; stride scheduling alternates, so both small
+    requests settle before the last two hogs."""
+    eng = _engine(max_batch=1, queue_depth=32, batch_window_s=0.0,
+                  tenant_fair_share=True)
+    eng.warm_up()
+    with eng, _hang_dispatcher():
+        hang = eng.submit(_feed(), tenant="other")
+        _wait_queue_empty(eng)
+        hogs = [eng.submit(_feed(seed=i), tenant="hog") for i in range(6)]
+        smalls = [eng.submit(_feed(seed=i), tenant="small")
+                  for i in range(2)]
+        hang.exception(timeout=60)
+        for f in hogs + smalls:
+            assert f.result(timeout=60)[0].shape == (1, 4)
+    # settle order by seq: futures don't expose seq, but submissions are
+    # sequential (hogs first, then smalls), so the sorted completed seqs
+    # split into the hog six and the small two
+    completed = [r["seq"] for r in eng.accounting()["recent_outcomes"]
+                 if r["outcome"] == "completed"]
+    assert len(completed) == 8
+    hog_seqs = sorted(completed)[:6]
+    small_seqs = sorted(completed)[6:]
+    last_two_hogs = [completed.index(s) for s in hog_seqs[-2:]]
+    small_positions = [completed.index(s) for s in small_seqs]
+    assert max(small_positions) < max(last_two_hogs), (
+        f"stride scheduling must not starve the small tenant: "
+        f"completed order {completed}")
+
+
+def test_weights_bias_the_dispatch_share():
+    """vip at weight 2 vs std at weight 1: of the first 6 dispatches,
+    vip gets 4 (its pass advances half as fast)."""
+    eng = _engine(max_batch=1, queue_depth=32, batch_window_s=0.0,
+                  tenant_fair_share=True, tenant_weights="vip:2")
+    eng.warm_up()
+    with eng, _hang_dispatcher():
+        hang = eng.submit(_feed(), tenant="other")
+        _wait_queue_empty(eng)
+        vips = [eng.submit(_feed(seed=i), tenant="vip") for i in range(6)]
+        stds = [eng.submit(_feed(seed=i), tenant="std") for i in range(3)]
+        hang.exception(timeout=60)
+        for f in vips + stds:
+            f.result(timeout=60)
+    completed = [r["seq"] for r in eng.accounting()["recent_outcomes"]
+                 if r["outcome"] == "completed"]
+    vip_seqs = set(sorted(completed)[:6])
+    first6 = completed[:6]
+    assert sum(1 for s in first6 if s in vip_seqs) == 4, (
+        f"weight 2 should take 2/3 of early dispatches, got {first6}")
+
+
+def test_fair_share_does_not_break_exact_accounting_or_coalescing():
+    """Same-signature coalescing still fills the anchor's batch; the
+    ledger reconciles with the engine accounting per outcome."""
+    eng = _engine(max_batch=4, queue_depth=32, batch_window_s=0.1,
+                  tenant_fair_share=True)
+    eng.warm_up()
+    with eng:
+        futs = [eng.submit(_feed(seed=i), tenant=f"t{i % 3}")
+                for i in range(9)]
+        for f in futs:
+            assert f.result(timeout=60)[0].shape == (1, 4)
+    acct = eng.accounting()
+    assert acct["exact"] and acct["completed"] == 9
+    tenants = eng.tenant_accounting()
+    total = sum(t["outcomes"].get("completed", 0)
+                for t in tenants.values())
+    assert total == acct["completed"]

@@ -44,7 +44,26 @@ def _completed_rate(rec) -> float | None:
     return rates.get("outcome=completed")
 
 
-def render(snapshot: dict, clock: str) -> str:
+_BURN_ORDER = {"ok": 0, "warning": 1, "burning": 2}
+
+
+def _class_burn(replicas: dict) -> dict:
+    """Worst per-class SLO burn state across every scraped replica."""
+    classes: dict = {}
+    for rec in replicas.values():
+        for name, cls in ((rec.get("slo") or {}).get("classes")
+                          or {}).items():
+            state = cls.get("state", "unknown")
+            if _BURN_ORDER.get(state, -1) >= _BURN_ORDER.get(
+                    classes.get(name), -1):
+                classes[name] = state
+    return classes
+
+
+def render(snapshot: dict, clock: str, autoscaler: dict = None) -> str:
+    """``autoscaler`` (optional) is a ``FleetAutoscaler.status()`` dict
+    from an embedding process (the supervisor side); the scrape-only CLI
+    renders everything else without it."""
     replicas = snapshot["replicas"]
     fleet = snapshot["fleet"]
     up = sum(1 for r in replicas.values() if r.get("up"))
@@ -70,13 +89,34 @@ def render(snapshot: dict, clock: str) -> str:
         f"p99 {_fmt_ms(fleet['p99']).strip()}ms  "
         f"completed {int(done) if done is not None else '-'}  "
         f"slo {fleet['slo_state']}")
+    burn = _class_burn(replicas)
+    if burn:
+        out.append("slo burn: " + "  ".join(
+            f"{name}={burn[name]}" for name in sorted(burn)))
+    if autoscaler:
+        sense = autoscaler.get("sense") or {}
+        last = autoscaler.get("last_decision")
+        decision = (f"{last['action']} ({last['reason']}) — "
+                    f"{last['detail']}" if last else "none yet")
+        out.append(
+            f"autoscaler: replicas {sense.get('replicas', '-')} "
+            f"(spawning {sense.get('spawning', 0)}, draining "
+            f"{len(sense.get('draining') or [])})  "
+            f"{'HOT' if sense.get('hot') else 'calm'}  "
+            f"last: {decision}")
     tenants = sorted(fleet["tenants"].items(),
                      key=lambda kv: -kv[1]["occupancy_s"])
     if tenants:
-        out.append("top tenants (occupancy_s): " + ", ".join(
-            f"{name} {t['occupancy_s']:.2f} "
-            f"({sum(t['outcomes'].values())} reqs)"
-            for name, t in tenants[:5]))
+        out.append(f"{'TENANT':12} {'REQS':>6} {'COMPLETED':>9} "
+                   f"{'SHED':>6} {'QUOTA_SHED':>10} {'OCC_S':>8}")
+        for name, t in tenants[:8]:
+            outcomes = t.get("outcomes") or {}
+            out.append(
+                f"{name:12} {sum(outcomes.values()):>6} "
+                f"{outcomes.get('completed', 0):>9} "
+                f"{outcomes.get('shed', 0):>6} "
+                f"{t.get('quota_sheds', 0):>10} "
+                f"{t['occupancy_s']:>8.2f}")
     return "\n".join(out)
 
 

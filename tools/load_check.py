@@ -1005,6 +1005,440 @@ def leg_fleet_telemetry(name, ci, log_dir="."):
 
 
 # ---------------------------------------------------------------------------
+# fleet control-loop legs (--autoscale): SLO-driven autoscaling + tenant
+# fair-share — docs/SERVING.md "Fleet control loop". A supervised fleet
+# behind the router plus a FleetAutoscaler: a hot-tenant flood must burn
+# the SLO, scale OUT a second replica warm through the fleet-shared AOT
+# cache AND the fleet-shared autotune CostDatabase, shed the hot tenant
+# typed tenant_quota while innocent tenants keep completing, then scale
+# back IN strictly via preemption-drain once calm — fleet ledger exact
+# throughout, every decision typed/metered/audited.
+# ---------------------------------------------------------------------------
+
+_AUTOSCALE_REPLICA_ARGS = [
+    # a deliberately slow dispatcher (wide batch window) + a small queue
+    # so a hog flood piles real, sustained admission pressure
+    "--batch-window-s", "0.15", "--queue-depth", "16"]
+_AUTOSCALE_TENANT_FLAGS = [
+    # queue_depth 16 * frac 0.125 -> the hog caps at 2 queued slots: low
+    # enough that 8 open-loop hog threads (at most 4 in the in-flight
+    # batch + the rest queued) provably overrun it
+    "--set-flag", "FLAGS_serving_tenant_fair_share=1",
+    "--set-flag", "FLAGS_serving_tenant_quota_frac=0.125"]
+_AUTOSCALE_SLO_FLAGS = [
+    # squeezed burn windows (the telemetry leg's trick) so the
+    # burn -> recover round trip fits one CI leg
+    "--set-flag", "FLAGS_serving_slo_fast_window_s=2",
+    "--set-flag", "FLAGS_serving_slo_slow_window_s=6"]
+
+
+def _seed_shared_autotune_db(db_path):
+    """Populate the fleet-shared autotune CostDatabase IN-PROCESS with a
+    real (tiny) measured sweep over the replica probe's warm-up buckets.
+    ``build_probe`` guarantees the program CONTENT fingerprint matches
+    what every replica process builds, so a replica spawned with
+    ``FLAGS_autotune=use`` + this DB warms straight to best-known
+    configs: lookups hit, zero re-trials. (measure_candidates is not
+    safe under live traffic — which is exactly why the harness seeds the
+    DB offline and the fleet only ever consumes it.)"""
+    from paddle_tpu import tuning
+    from paddle_tpu.core.types import np_dtype
+    from paddle_tpu.serving.fleet.replica import build_probe
+
+    fluid.set_flags({"FLAGS_autotune": "measure",
+                     "FLAGS_autotune_db": db_path})
+    tuning.reset_database_cache()
+    eng, _meta = build_probe("mlp_tiny", serving.ServingConfig(max_batch=4))
+    db = tuning.get_database(db_path)
+    candidates = [tuning.TunedConfig.make({}),
+                  tuning.TunedConfig.make(
+                      {"xla_cpu_enable_fast_min_max": True})]
+    blk = eng._program.global_block
+    buckets = []
+    for b in (1, 2, 4):   # the warm-up buckets for max_batch=4
+        feed = {}
+        for n in eng._feed_names:
+            v = blk.var(n)
+            tail = tuple(int(d) for d in v.shape[1:])
+            feed[n] = np.zeros((b,) + tail, dtype=np_dtype(v.dtype))
+        rep = tuning.measure_candidates(
+            eng._exe, eng._program, feed, eng._fetch_names, eng._scope,
+            candidates=candidates, k_short=1, k_long=2, repeats=1,
+            batch_rows=b, db=db)
+        buckets.append(rep["bucket"])
+    # this process is done measuring; the fleet consumes in use mode
+    fluid.set_flags({"FLAGS_autotune": "use"})
+    return {"path": db_path, "trials": db.trial_count(),
+            "buckets": buckets}
+
+
+def _drive_autoscale_burst(router, stop_ev, pause_ev=None, hog_threads=8,
+                           small_tenants=("acme", "globex")):
+    """Open-loop hog flood (each thread re-submits immediately; typed
+    sheds back off a beat) + one closed-loop thread per innocent tenant.
+    Outcomes are counted per tenant WITH the Overloaded reason split
+    out: ``shed_tenant_quota`` vs ``shed_other`` is the whole point of
+    the leg. Innocent-tenant latencies are collected caller-side for
+    the p99-held check. ``pause_ev`` set suspends the HOG threads only
+    (the leg pauses the flood while the scaled-out replica spawns, so
+    the warm-vs-cold time-to-ready comparison is load-for-load fair on
+    a small box — the innocents keep trickling). Everything submits at
+    priority 5: the engine's own degraded mode sheds below
+    ``degraded_min_priority``, and this leg needs ``tenant_quota`` to
+    be the ONLY shed in play."""
+    from paddle_tpu.serving.fleet import ReplicaLost
+
+    lock = threading.Lock()
+    seen = {"submitted": 0, "completed": 0, "shed_tenant_quota": 0,
+            "shed_other": 0, "failed": 0, "deadline": 0,
+            "circuit_open": 0, "stopped": 0, "replica_lost": 0,
+            "other_error": 0}
+    per_tenant = {}
+    small_latencies = []
+
+    def note(tenant, key, latency=None):
+        with lock:
+            seen["submitted"] += 1
+            seen[key] += 1
+            t = per_tenant.setdefault(tenant, {})
+            t[key] = t.get(key, 0) + 1
+            if latency is not None and tenant in small_tenants:
+                small_latencies.append(latency)
+
+    def one(tenant, seed):
+        t0 = time.perf_counter()
+        try:
+            router.submit(_mlp_feed(rows=1, seed=seed % 100000),
+                          priority=5, tenant=tenant)
+            note(tenant, "completed", time.perf_counter() - t0)
+            return True
+        except serving.Overloaded as e:
+            note(tenant, "shed_tenant_quota"
+                 if getattr(e, "reason", "") == "tenant_quota"
+                 else "shed_other")
+        except serving.BatchFailed:
+            note(tenant, "failed")
+        except serving.DeadlineExceeded:
+            note(tenant, "deadline")
+        except serving.CircuitOpen:
+            note(tenant, "circuit_open")
+        except serving.EngineStopped:
+            note(tenant, "stopped")
+        except ReplicaLost:
+            note(tenant, "replica_lost")
+        except Exception:
+            note(tenant, "other_error")
+        return False
+
+    def hog(tid):
+        i = 0
+        while not stop_ev.is_set():
+            if pause_ev is not None and pause_ev.is_set():
+                time.sleep(0.05)
+                continue
+            if not one("hog", tid * 1000 + i):
+                time.sleep(0.01)
+            i += 1
+
+    def small(tenant, tid):
+        i = 0
+        while not stop_ev.is_set():
+            one(tenant, 7000 + tid * 1000 + i)
+            i += 1
+            time.sleep(0.05)
+
+    threads = [threading.Thread(target=hog, args=(t,))
+               for t in range(hog_threads)]
+    threads += [threading.Thread(target=small, args=(name, t))
+                for t, name in enumerate(small_tenants)]
+    for t in threads:
+        t.start()
+    return threads, seen, per_tenant, small_latencies
+
+
+def leg_autoscale(name, ci, log_dir="."):
+    """--autoscale: the closed fleet control loop, end to end over
+    processes. One supervised replica starts COLD (empty AOT cache, but
+    the harness-seeded shared autotune DB); a hot-tenant flood burns the
+    SLO budget through typed tenant_quota sheds; the FleetAutoscaler
+    must scale out a second replica (warm: shared AOT cache + autotune
+    hits, zero re-trials, measurably faster time-to-ready than the cold
+    baseline), refuse further scale-out typed at_max_replicas, and —
+    once the burst stops and the squeezed burn windows drain — scale
+    back in strictly via preemption-drain (victim exits 0 with an exact
+    ledger) then hold the floor typed at_min_replicas. Innocent tenants
+    must keep completing with their caller-side p99 held the whole
+    time."""
+    from paddle_tpu import flags as flags_mod
+    from paddle_tpu.serving.fleet import (AutoscalerConfig,
+                                          FleetAutoscaler,
+                                          ReplicaSupervisor,
+                                          SupervisorConfig)
+
+    aot_dir = tempfile.mkdtemp(prefix="paddle_tpu_autoscale_aot_")
+    db_dir = tempfile.mkdtemp(prefix="paddle_tpu_autoscale_db_")
+    db_path = os.path.join(db_dir, "autotune_db.json")
+    saved_overrides = dict(flags_mod._overrides)
+    router = sup = auto = None
+    stop_ev = threading.Event()
+    threads = []
+    try:
+        seeded = _seed_shared_autotune_db(db_path)
+        replica_args = (_AUTOSCALE_REPLICA_ARGS + _AUTOSCALE_TENANT_FLAGS
+                        + _AUTOSCALE_SLO_FLAGS)
+        router = _chaos_router(request_timeout_s=30.0)
+        sup = ReplicaSupervisor(
+            router,
+            SupervisorConfig(
+                ready_timeout_s=240.0, exit_grace_s=60.0,
+                # the fleet-shared autotune story rides EVERY spawn —
+                # including the autoscaler's, which never mentions it
+                shared_flags={"FLAGS_autotune": "use",
+                              "FLAGS_autotune_db": db_path}),
+            log_dir=log_dir, env=_replica_env(), cwd=_REPO_ROOT)
+        sup.add_replica("r0", "mlp_tiny", aot_dir,
+                        extra_args=replica_args)
+        cold = sup.handle("r0").wait_ready(240)
+        router.start()
+        assert _wait_routable(router, "r0")
+
+        auto = FleetAutoscaler(
+            sup, router=router,
+            config=AutoscalerConfig(
+                min_replicas=1, max_replicas=2, interval_s=0.2,
+                cooldown_s=2.0, hot_sustain_s=1.0, calm_sustain_s=3.0,
+                max_inflight_spawns=1, queue_high=4),
+            model="mlp_tiny", aot_dir=aot_dir, extra_args=replica_args)
+        auto.start()
+
+        pause_ev = threading.Event()
+        threads, seen, per_tenant, small_lat = _drive_autoscale_burst(
+            router, stop_ev, pause_ev)
+        # the flood sheds the hog typed tenant_quota; sheds are bad SLO
+        # outcomes, so the burn state flips and SUSTAINS -> scale-out
+        warm = None
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline and "as1" not in sup.status():
+            time.sleep(0.1)
+        scaled_spawned = "as1" in sup.status()
+        if scaled_spawned:
+            # suspend the hog flood while the spawn warms up: the cold
+            # baseline spawned on an idle box, and the point of the
+            # comparison is the shared caches, not CPU contention
+            pause_ev.set()
+            warm = sup.handle("as1").wait_ready(240)
+            _wait_routable(router, "as1")
+            pause_ev.clear()
+        # keep the burst on the scaled-out fleet: the refusal ladder at
+        # max_replicas must fire typed while both replicas take traffic
+        time.sleep(3.5 if ci else 5.0)
+        stop_ev.set()
+        for t in threads:
+            t.join(120)
+
+        # calm: the squeezed windows drain, the loop must scale back IN
+        # strictly via preemption-drain of the replica it spawned
+        drained_clean = False
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            if sup.status().get("as1", {}).get("state") == "stopped":
+                drained_clean = True
+                break
+            time.sleep(0.2)
+        # hold the floor a beat: at_min_replicas must be typed + metered
+        time.sleep(2.0 if ci else 3.0)
+        status = auto.status()
+        audit = status["audit"]
+        auto.stop()
+        acct = router.accounting()
+        as1 = sup.handle("as1") if scaled_spawned else None
+        victim_acct = ((as1.exit_info or {}).get("accounting") or {}) \
+            if as1 is not None else {}
+        last_exit = (as1.last_exit or {}) if as1 is not None else {}
+        sup.stop(drain=True)
+        router.stop()
+
+        seen["terminal"] = sum(v for k, v in seen.items()
+                               if k not in ("submitted", "terminal"))
+
+        def decided(action, reason=None):
+            return any(e["action"] == action
+                       and (reason is None or e["reason"] == reason)
+                       for e in audit)
+
+        out = next((e for e in audit if e["action"] == "scale_out"), None)
+        hog = per_tenant.get("hog", {})
+        smalls = {t: per_tenant.get(t, {}) for t in ("acme", "globex")}
+        small_shed = sum(v.get("shed_tenant_quota", 0)
+                         + v.get("shed_other", 0)
+                         for v in smalls.values())
+        p99 = (sorted(small_lat)[max(0, int(0.99 * (len(small_lat) - 1)))]
+               if small_lat else None)
+
+        checks = {
+            "scale_out_on_sustained_hot":
+                out is not None and scaled_spawned and warm is not None,
+            "scale_out_reason_typed_hot":
+                out is not None
+                and (out["reason"] == "slo_burn"
+                     or out["reason"].startswith("pressure")),
+            "warm_ready_faster_than_cold":
+                warm is not None
+                and warm["time_to_ready_s"] < cold["time_to_ready_s"],
+            "warm_loaded_from_aot_cache":
+                warm is not None and warm["aot_cache"]["hits"] >= 1
+                and warm["aot_cache"]["misses"] == 0,
+            "autotune_shared_db_hit":
+                warm is not None and warm["autotune"]["mode"] == "use"
+                and warm["autotune"]["hits"] >= 1,
+            "autotune_zero_retrials":
+                warm is not None and warm["autotune"]["trials"] == 0
+                and cold["autotune"]["trials"] == 0,
+            "hot_tenant_shed_typed_tenant_quota":
+                hog.get("shed_tenant_quota", 0) >= 1,
+            "innocent_tenants_kept_admitted":
+                small_shed == 0
+                and all(v.get("completed", 0) >= 3
+                        for v in smalls.values()),
+            "innocent_p99_held": p99 is not None and p99 < 5.0,
+            "refusal_ladder_typed":
+                decided("refuse_scale_out", "at_max_replicas")
+                and decided("refuse_scale_in", "at_min_replicas"),
+            "refusals_metered":
+                monitor.metric_value("autoscaler_decisions_total", 0.0,
+                                     action="refuse_scale_out",
+                                     reason="at_max_replicas") >= 1,
+            "calm_scale_in_via_drain": decided("scale_in", "calm"),
+            "victim_drained_clean":
+                drained_clean and last_exit.get("reason") == "drain"
+                and last_exit.get("rc") == 0,
+            "victim_ledger_exact":
+                bool(victim_acct.get("exact"))
+                and victim_acct.get("pending") == 0,
+            "exact_fleet_accounting": bool(acct["exact"]),
+            "every_submit_terminal":
+                seen["terminal"] == seen["submitted"],
+            "no_untyped_errors": seen["other_error"] == 0,
+            "nothing_admitted_lost":
+                seen["replica_lost"] == 0 and seen["stopped"] == 0,
+        }
+        warmstart = {
+            "cold": {k: cold.get(k) for k in
+                     ("time_to_ready_s", "warm_up_s", "aot_cache",
+                      "autotune")},
+            "warm": ({k: warm.get(k) for k in
+                      ("time_to_ready_s", "warm_up_s", "aot_cache",
+                       "autotune")} if warm is not None else None),
+            "ready_speedup": (cold["time_to_ready_s"]
+                              / max(warm["time_to_ready_s"], 1e-9)
+                              if warm is not None else None),
+        }
+        return {"name": name, "ok": all(checks.values()),
+                "requests": seen["submitted"], "caller_view": seen,
+                "router_accounting": acct,
+                "victim_accounting": victim_acct,
+                "tenants": per_tenant, "autotune_seed": seeded,
+                "warmstart": warmstart,
+                "innocent_latency": {"count": len(small_lat),
+                                     "p99_s": p99},
+                "autoscaler": {"audit": audit,
+                               "last_decision": status["last_decision"],
+                               "spawned": status["spawned"]},
+                "checks": checks,
+                "why": "hot-tenant SLO burn scales out warm (shared AOT "
+                       "cache + autotune DB, zero re-trials), the hog is "
+                       "shed typed tenant_quota while innocents hold, "
+                       "calm scales back in via preemption-drain with "
+                       "the fleet ledger exact, and every refusal is "
+                       "typed + metered"}
+    finally:
+        stop_ev.set()
+        for t in threads:
+            t.join(10)
+        if auto is not None:
+            auto.stop()
+        if sup is not None:
+            sup.stop(drain=True)
+        if router is not None:
+            router.stop()
+        shutil.rmtree(aot_dir, ignore_errors=True)
+        shutil.rmtree(db_dir, ignore_errors=True)
+        flags_mod._overrides.clear()
+        flags_mod._overrides.update(saved_overrides)
+
+
+def leg_autoscale_negative(name, ci, log_dir="."):
+    """--autoscale --negative-control: NO autoscaler attached and tenant
+    fair-share off. The same hog flood piles real queue pressure, but
+    nothing answers it: the replica count stays pinned at one and the
+    hog's sheds (if any) stay untyped-by-tenant — the control-loop
+    checks must FAIL the gate."""
+    from paddle_tpu.serving.fleet import (ReplicaSupervisor,
+                                          SupervisorConfig)
+
+    aot_dir = tempfile.mkdtemp(prefix="paddle_tpu_autoscale_neg_aot_")
+    router = sup = None
+    stop_ev = threading.Event()
+    threads = []
+    try:
+        router = _chaos_router(request_timeout_s=30.0)
+        sup = ReplicaSupervisor(
+            router, SupervisorConfig(ready_timeout_s=240.0,
+                                     exit_grace_s=60.0),
+            log_dir=log_dir, env=_replica_env(), cwd=_REPO_ROOT)
+        sup.add_replica(
+            "r0", "mlp_tiny", aot_dir,
+            extra_args=_AUTOSCALE_REPLICA_ARGS + _AUTOSCALE_SLO_FLAGS)
+        sup.handle("r0").wait_ready(240)
+        router.start()
+        assert _wait_routable(router, "r0")
+
+        threads, seen, per_tenant, _lat = _drive_autoscale_burst(
+            router, stop_ev)
+        peak_queue = 0
+        t_end = time.monotonic() + (4.0 if ci else 6.0)
+        while time.monotonic() < t_end:
+            router.poll_now()
+            r = router.get_replica("r0")
+            if r is not None:
+                peak_queue = max(peak_queue,
+                                 r.snapshot().get("queue_depth", 0))
+            time.sleep(0.1)
+        stop_ev.set()
+        for t in threads:
+            t.join(120)
+        acct = router.accounting()
+        seen["terminal"] = sum(v for k, v in seen.items()
+                               if k not in ("submitted", "terminal"))
+        hog = per_tenant.get("hog", {})
+        checks = {
+            # sanity (passes): the hot condition was genuinely present
+            "hot_pressure_observed": peak_queue >= 4,
+            "exact_fleet_accounting": bool(acct["exact"]),
+            # the control-loop requirements (must FAIL):
+            "scale_out_on_sustained_hot": len(sup.status()) > 1,
+            "hot_tenant_shed_typed_tenant_quota":
+                hog.get("shed_tenant_quota", 0) >= 1,
+        }
+        return {"name": name, "ok": all(checks.values()),
+                "requests": seen["submitted"], "caller_view": seen,
+                "router_accounting": acct, "tenants": per_tenant,
+                "peak_queue_depth": peak_queue, "checks": checks,
+                "why": "no autoscaler + no tenant quotas: sustained "
+                       "pressure goes unanswered and the hot tenant is "
+                       "never shed typed — the gate must FAIL"}
+    finally:
+        stop_ev.set()
+        for t in threads:
+            t.join(10)
+        if sup is not None:
+            sup.stop(drain=True)
+        if router is not None:
+            router.stop()
+        shutil.rmtree(aot_dir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # fleet self-healing legs (--fleet-chaos): supervisor + bisection + wire
 # chaos — ISSUE 15's gate. Three failure families against a 2-replica
 # fleet: injected wire faults (drop + stall + corrupt), one poison
@@ -1517,6 +1951,18 @@ def main(argv=None) -> int:
                          "With --negative-control the supervisor never "
                          "restarts and bisection is off — the gate must "
                          "FAIL")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the fleet CONTROL-LOOP gate: a supervised "
+                         "replica + FleetAutoscaler under a hot-tenant "
+                         "flood — sustained SLO burn scales out a second "
+                         "replica warm (shared AOT cache + shared "
+                         "autotune DB, zero re-trials), the hog is shed "
+                         "typed tenant_quota while innocent tenants hold "
+                         "their p99, calm scales back in strictly via "
+                         "preemption-drain (ledger exact), and every "
+                         "refusal is typed + metered. With "
+                         "--negative-control there is no autoscaler and "
+                         "no tenant quotas — the gate must FAIL")
     ap.add_argument("--log-dir", default=".",
                     help="where fleet replica stderr logs land")
     ap.add_argument("--lock-witness", action="store_true",
@@ -1589,6 +2035,58 @@ def main(argv=None) -> int:
             print(f"fleet-chaos artifact written to {args.json}")
         if args.concurrency_json and witness is not None:
             _merge_concurrency_json(args.concurrency_json, witness)
+        return 0 if gate_ok else 1
+    if args.autoscale:
+        if args.negative_control:
+            legs.append(leg_autoscale_negative("autoscale_open_loop", ci,
+                                               args.log_dir))
+        else:
+            legs.append(leg_autoscale("autoscale_control_loop", ci,
+                                      args.log_dir))
+        gate_ok = all(l["ok"] for l in legs)
+        for l in legs:
+            status = "ok" if l["ok"] else "MISS"
+            print(f"[{status}] {l['name']}: {l['requests']} requests -> "
+                  + ", ".join(f"{k}={v}" for k, v in
+                              sorted(l["caller_view"].items()) if v))
+            for k, v in sorted(l.get("checks", {}).items()):
+                if not v:
+                    print(f"       FAILED check: {k}")
+            for tname in sorted(l.get("tenants", {})):
+                tview = ", ".join(
+                    f"{k}={v}" for k, v in
+                    sorted(l["tenants"][tname].items()) if v)
+                print(f"tenant {tname}: {tview}")
+            ws = l.get("warmstart")
+            if ws and ws.get("warm"):
+                print(f"scale-out warm start: cold ready "
+                      f"{ws['cold']['time_to_ready_s']:.2f}s -> warm "
+                      f"{ws['warm']['time_to_ready_s']:.2f}s "
+                      f"(speedup {ws['ready_speedup']:.1f}x), autotune "
+                      f"hits={ws['warm']['autotune']['hits']} "
+                      f"trials={ws['warm']['autotune']['trials']}, "
+                      f"aot hits={ws['warm']['aot_cache']['hits']} "
+                      f"misses={ws['warm']['aot_cache']['misses']}")
+            for e in (l.get("autoscaler") or {}).get("audit", []):
+                print(f"autoscaler: {e['action']} ({e['reason']}) "
+                      f"x{e['count']} — {e['detail']}")
+        print(f"serving gate ({time.time() - t0:.1f}s) -> "
+              f"{'ok' if gate_ok else 'FAIL'}")
+        if args.json:
+            with open(args.json, "w", encoding="utf-8") as f:
+                json.dump({
+                    "legs": legs,
+                    "autoscaler": next(
+                        (l.get("autoscaler") for l in legs
+                         if l.get("autoscaler")), None),
+                    "warmstart": next((l.get("warmstart") for l in legs
+                                       if l.get("warmstart")), None),
+                    "snapshot": monitor.snapshot(),
+                    "check": {"status": "ok" if gate_ok else "fail",
+                              "negative_control":
+                                  bool(args.negative_control)},
+                }, f, indent=2, default=str)
+            print(f"autoscale artifact written to {args.json}")
         return 0 if gate_ok else 1
     if args.fleet:
         if args.negative_control:
