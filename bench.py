@@ -227,6 +227,54 @@ def bench_bert_train(batch=32, seq_len=512, k_short=2, k_long=8,
     return steps_per_s, tflops, batch, seq_len
 
 
+def bench_gpt_decode(speculative: bool = False, n_requests: int = 8,
+                     max_new: int = 56):
+    """GPT-tiny generation tokens/s through the generative serving engine
+    (ISSUE 20) — the decode headline. Single-stream latency-bound greedy
+    traffic with a shared 12-token prefix, so the number reflects the
+    real decode path: prefix-cache admission, chunked prefill, paged-KV
+    decode chunks, and (``speculative=True``) k=8 draft-verify chunks
+    committing up to 9 tokens per dispatch. Greedy speculative output is
+    bit-exact vs plain by construction (tests + the load_check gate
+    enforce it), so the two legs are directly comparable. Returns
+    ``(tokens_per_s, generation_stats)``."""
+    import paddle_tpu as fluid
+    import paddle_tpu.unique_name as un
+    from paddle_tpu import serving
+    from paddle_tpu.models.gpt import GptConfig, build_gpt_generative
+
+    with un.guard():
+        net = build_gpt_generative(GptConfig.tiny(), batch_slots=4,
+                                   max_seq=128, page_size=8,
+                                   prompt_buckets=(8, 16), spec_k=8)
+    exe = fluid.Executor(fluid.TPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(net["startup"], scope=scope)
+    eng = serving.GenerativeEngine(
+        net, scope=scope, executor=exe,
+        config=serving.ServingConfig(max_batch=4, queue_depth=64,
+                                     deadline_s=0),
+        gen_config=serving.GenerationConfig(decode_chunk=2,
+                                            speculative=speculative))
+    eng.warm_up()
+    rng = np.random.RandomState(5)
+    shared = rng.randint(1, 128, 12)
+    toks, t0 = 0, time.time()
+    with eng:
+        for i in range(n_requests):
+            p = np.concatenate([shared, rng.randint(1, 128, 2 + i % 3)])
+            out = eng.submit(p, max_new_tokens=max_new) \
+                .result(timeout=600)[0]
+            toks += len(out)
+    wall = time.time() - t0
+    stats = eng.generation_stats()
+    if not eng.accounting()["exact"] or stats["decode_recompiles"]:
+        raise RuntimeError("decode bench integrity: accounting inexact "
+                           "or warm recompiles observed")
+    return toks / wall if wall > 0 else 0.0, stats
+
+
 def main():
     """Sections run independently: one that RAISES never loses the others
     and the JSON line still prints (a section that hangs is still fatal —
@@ -273,6 +321,27 @@ def main():
     # the 16 GB chip (bs=32 peak ~2x'd by doubling the batch)
     bert64 = section("bert_bs64_remat",
                      lambda: bench_bert_train(batch=64, auto_remat=True))
+    # decode headline (ISSUE 20): tokens/s through the generative engine,
+    # plain and speculative, plus the prefix-cache hit stats
+    gpt_dec = section("gpt_tiny_decode", lambda: bench_gpt_decode(False))
+    gpt_spec = section("gpt_tiny_decode_spec",
+                       lambda: bench_gpt_decode(True))
+    if gpt_dec is not None:
+        tps_plain, dec_stats = gpt_dec
+        extra["gpt_tiny_decode_tokens_per_s"] = round(tps_plain, 1)
+        extra["prefix_cache"] = dec_stats["prefix_cache"]
+    if gpt_spec is not None:
+        tps_spec, spec_stats = gpt_spec
+        extra["gpt_tiny_decode_spec_tokens_per_s"] = round(tps_spec, 1)
+        extra["gpt_tiny_decode_spec"] = {
+            "k": spec_stats["speculative"]["k"],
+            "verify_chunks": spec_stats["speculative"]["chunks"],
+            "accepted_tokens":
+                spec_stats["speculative"]["accepted_tokens"],
+        }
+        if gpt_dec is not None and tps_plain > 0:
+            extra["gpt_tiny_decode_spec_speedup"] = round(
+                tps_spec / tps_plain, 3)
 
     if train_bf16 is not None:
         train_tflops = train_bf16 * RESNET50_TRAIN_GFLOP_PER_IMG / 1e3

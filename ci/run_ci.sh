@@ -158,15 +158,22 @@ echo "   request reaches exactly one terminal outcome; p50/p99 latency"
 echo "   histogram is the artifact. --decode adds the generative legs: a"
 echo "   GPT-tiny multi-thread generation burst with exact accounting,"
 echo "   zero warm recompiles and tokens/s + inter-token p50/p99 in the"
-echo "   artifact, plus a chaos sub-leg killing one in-flight batch —"
-echo "   every affected stream must settle with a typed outcome)"
+echo "   artifact, a chaos sub-leg killing one in-flight batch — every"
+echo "   affected stream must settle with a typed outcome — plus the"
+echo "   ISSUE 20 legs: a shared-prefix burst (prefix hits > 0, warm"
+echo "   first-token faster than cold, hit ratio + first-token p99 in"
+echo "   the artifact) and a speculative leg (greedy output bit-exact vs"
+echo "   non-speculative at >= 1.5x tokens/s, acceptance histogram"
+echo "   present)"
 JAX_PLATFORMS=cpu python tools/load_check.py --ci --decode \
-  --json "${CI_ARTIFACT_DIR:-.}/ci_serving_report.json" | tail -10
-echo "== serving negative control (shedding disabled: the gate must FAIL)"
+  --json "${CI_ARTIFACT_DIR:-.}/ci_serving_report.json" | tail -13
+echo "== serving negative control (shedding disabled, prefix cache off —"
+echo "   hit counters must stay zero — and speculation off — no"
+echo "   acceptance histogram may exist: the gate must FAIL)"
 SERVING_NEG_LOG="${CI_ARTIFACT_DIR:-.}/ci_serving_negative.log"
-if JAX_PLATFORMS=cpu python tools/load_check.py --ci \
+if JAX_PLATFORMS=cpu python tools/load_check.py --ci --decode \
      --negative-control > "$SERVING_NEG_LOG" 2>&1; then
-  echo "load_check --ci did NOT fail with shedding disabled" >&2
+  echo "load_check --ci did NOT fail with shedding/prefix/spec disabled" >&2
   exit 1
 fi
 # non-zero exit must be the gate tripping, not the harness crashing

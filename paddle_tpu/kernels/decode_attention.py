@@ -1,11 +1,16 @@
 """Decode-step flash attention over a paged/block KV cache (Pallas TPU).
 
-The autoregressive-serving counterpart of ``flash_attention.py``: one query
-token per sequence (q_len == 1) attends against that sequence's KV cache.
-The cache is *paged* — logically ``[BH, S_max, D]`` where
-``S_max = num_pages * page_size`` and the kernel walks it one page
-(``block_k = page_size``) at a time with the same online-softmax recurrence
-as the prefill kernel, masking key positions ``>= length`` per sequence.
+The autoregressive-serving counterpart of ``flash_attention.py``: a short
+*chunk* of query tokens per sequence (1 <= q_len <= 8) attends against that
+sequence's KV cache. q_len == 1 is the classic decode step; q_len > 1 is
+the chunked-prefill slice and the speculative-verify chunk (ISSUE 20),
+where query row ``i`` is the token at cache position ``length - 1 + i`` and
+may see exactly ``length + i`` keys (causal *within* the chunk, since the
+chunk's own K rows are appended before the walk). The cache is *paged* —
+logically ``[BH, S_max, D]`` where ``S_max = num_pages * page_size`` and
+the kernel walks it one page (``block_k = page_size``) at a time with the
+same online-softmax recurrence as the prefill kernel, masking key positions
+``>= length + row`` per sequence and query row.
 Pages past a sequence's length hold stale/garbage rows by design (they are
 overwritten when the sequence reaches them); the length mask keeps them out
 of the softmax, so cache capacity can be provisioned once and reused across
@@ -22,10 +27,12 @@ prove the cache buffer donatable: its last read is not after its last
 write).
 
 Design notes
-- q rides sublane-replicated ``[BH, 8, D]`` (Mosaic needs the
-  second-to-last dim divisible by 8 for f32; a 1-row tile violates that,
-  8 replicated rows don't — see ``flash_attention._rows8``). Row 0 of the
-  output is the real result.
+- q rides in ``[BH, 8, D]`` sublane tiles (Mosaic needs the second-to-last
+  dim divisible by 8 for f32; a 1-row tile violates that — see
+  ``flash_attention._rows8``). The 8 sublane rows ARE the chunk's query
+  rows: rows ``q_len..7`` are padding (replicas of the last real row) whose
+  output is discarded, so the q_len=1 decode step and the q_len<=8 chunk
+  use one kernel with a per-row length mask ``k_pos < length + row``.
 - per-sequence lengths arrive as scalar-prefetch values so the kernel's
   mask needs no extra VMEM traffic; ``lengths[bh // num_heads]`` maps the
   fused B*H grid axis back to its batch row.
@@ -44,7 +51,7 @@ from jax.experimental.pallas import tpu as pltpu
 from .flash_attention import NEG_INF, CompilerParams, _out_sds
 
 __all__ = ["flash_attention_decode", "paged_kv_append",
-           "decode_attention_reference"]
+           "paged_kv_append_rows", "decode_attention_reference"]
 
 
 def paged_kv_append(cache, new, positions):
@@ -68,16 +75,39 @@ def paged_kv_append(cache, new, positions):
     return jax.vmap(upd)(cache, new, positions)
 
 
+def paged_kv_append_rows(cache, new, positions):
+    """Chunked KV write with PER-ROW clamping: row ``i`` of ``new``
+    ([B, ..., C, D]) lands at ``min(positions + i, S_max - 1)``. Unlike
+    :func:`paged_kv_append` (one ``dynamic_update_slice`` of the whole
+    block, whose out-of-range START shifts backwards over real rows), a
+    chunk whose tail crosses the cache end collapses its overflow rows
+    onto the LAST row — and the last row is never inside a live length
+    mask (the serving layer caps ``prompt + max_new <= S_max`` and the
+    final generated token is never appended), so overflow is unreadable
+    garbage, not corruption."""
+    S = cache.shape[-2]
+    C = new.shape[-2]
+    positions = positions.reshape(positions.shape[0]).astype(jnp.int32)
+    for i in range(C):
+        row_pos = jnp.minimum(positions + i, S - 1)
+        cache = paged_kv_append(cache, new[..., i:i + 1, :], row_pos)
+    return cache
+
+
 def decode_attention_reference(q, k_cache, v_cache, lengths, scale):
-    """Primitive oracle: masked softmax attention of one query row per
-    sequence against its cache. q: [BH, 1, D]; caches: [BH, S, D];
-    lengths: [BH] (already expanded per head). Matches the kernel
-    semantics exactly; also the op's off-TPU lowering."""
+    """Primitive oracle: masked softmax attention of a chunk of query rows
+    per sequence against its cache. q: [BH, Sq, D]; caches: [BH, S, D];
+    lengths: [BH] (already expanded per head) — the number of keys visible
+    to query row 0; row ``i`` sees ``lengths + i`` keys (causal within the
+    chunk, whose K rows were appended before the attention). Sq == 1 is
+    the classic decode step. Matches the kernel semantics exactly; also
+    the op's off-TPU lowering."""
     prec = "highest" if q.dtype == jnp.float32 else "default"
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                    k_cache.astype(jnp.float32), precision=prec) * scale
     k_pos = jnp.arange(k_cache.shape[1])[None, None, :]
-    s = jnp.where(k_pos < lengths[:, None, None], s, NEG_INF)
+    row = jnp.arange(q.shape[1])[None, :, None]
+    s = jnp.where(k_pos < lengths[:, None, None] + row, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bqk,bkd->bqd", p, v_cache.astype(jnp.float32),
                    precision=prec)
@@ -97,12 +127,16 @@ def _decode_kernel(scale, num_heads, scal_ref, q_ref, k_ref, v_ref,
         acc[:] = jnp.zeros_like(acc)
 
     length = scal_ref[bh // num_heads]
-    q = q_ref[0]                                    # [8, D] (replicated)
+    q = q_ref[0]                                    # [8, D] (chunk rows)
     k = k_ref[0]                                    # [block_k, D]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    s = jnp.where(k_pos < length, s, NEG_INF)       # page-level length mask
+    # per-row causal length: query row i (the token at cache position
+    # length - 1 + i) sees length + i keys; padding rows past the real
+    # chunk see more keys, but their output is sliced away by the caller
+    row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    s = jnp.where(k_pos < length + row, s, NEG_INF)
 
     m_prev = m_scr[:, :1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -128,21 +162,27 @@ def flash_attention_decode(q, k_cache, v_cache, lengths, *,
                            scale=None, num_heads: int = 1,
                            page_size: int = 128,
                            interpret: bool = False):
-    """One decode step: q [BH, 1, D] against paged caches [BH, S_max, D].
+    """One decode/verify chunk: q [BH, Sq, D] (1 <= Sq <= 8) against paged
+    caches [BH, S_max, D].
 
     ``lengths`` is per-BATCH ([B] int, B = BH // num_heads): the number of
-    valid key rows per sequence (positions >= length are masked out).
+    valid key rows visible to query row 0; row ``i`` sees ``lengths + i``
+    keys (causal within the chunk — the chunk's K rows are appended to the
+    cache before the walk). Sq == 1 is the classic decode step; Sq > 1 is
+    the chunked-prefill / speculative-verify shape riding the same 8-row
+    sublane tile (rows past Sq are padding, sliced off the output).
     ``page_size`` is the kernel's k-block — the cache page granularity;
     ``S_max`` must divide into whole pages
     (``flash_attention.classify_shapes`` refuses otherwise). Returns
-    o [BH, 1, D]. Inference-only (no VJP).
+    o [BH, Sq, D]. Inference-only (no VJP).
     """
     BH, Sq, D = q.shape
     Sk = k_cache.shape[1]
-    if Sq != 1:
+    if not 1 <= Sq <= 8:
         raise ValueError(
-            f"flash_attention_decode is the q_len=1 path, got q_len={Sq}; "
-            f"use flash_attention for prefill/full-sequence shapes")
+            f"flash_attention_decode is the q_len<=8 chunk path (one "
+            f"sublane tile), got q_len={Sq}; use flash_attention for "
+            f"prefill/full-sequence shapes")
     bk = min(page_size, Sk)
     if Sk % bk:
         raise ValueError(
@@ -154,8 +194,13 @@ def flash_attention_decode(q, k_cache, v_cache, lengths, *,
         raise ValueError(
             f"lengths has {lengths.shape[0]} rows but q has BH={BH} with "
             f"num_heads={num_heads} (expected {BH // num_heads})")
-    # sublane-replicate the single query row: [BH, 1, D] -> [BH, 8, D]
-    q8 = jnp.broadcast_to(q, (BH, 8, D))
+    # pad the chunk to one full sublane tile: [BH, Sq, D] -> [BH, 8, D]
+    # (replicas of the last real row; their output is sliced away)
+    if Sq == 8:
+        q8 = q
+    else:
+        q8 = jnp.concatenate(
+            [q, jnp.broadcast_to(q[:, -1:, :], (BH, 8 - Sq, D))], axis=1)
     nk = Sk // bk
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -182,4 +227,4 @@ def flash_attention_decode(q, k_cache, v_cache, lengths, *,
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(lengths, q8, k_cache, v_cache)
-    return o8[:, :1, :]
+    return o8[:, :Sq, :]

@@ -36,7 +36,7 @@ __all__ = [
     "lstm_unit", "autoincreased_step_counter", "adaptive_pool3d",
     "beam_search", "beam_search_decode", "filter_by_instag",
     "fused_decode_attention", "kv_cache_append", "sequence_gather",
-    "sample_token",
+    "sample_token", "spec_accept",
 ]
 
 
@@ -921,21 +921,29 @@ def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True):
 
 
 def fused_decode_attention(q, k_new, v_new, cache_k, cache_v, positions,
-                           scale=0.0, page_size=128, name=None):
-    """One autoregressive decode step with the KV append fused in
-    (ops/generation.py). q/k_new/v_new: [B, H, 1, D]; cache_k/cache_v:
+                           scale=0.0, page_size=128, slot_mask=None,
+                           name=None):
+    """One autoregressive decode/verify chunk with the KV append fused in
+    (ops/generation.py). q/k_new/v_new: [B, H, C, D] (C == 1 is the
+    classic decode step; C <= 8 rides the chunk kernel); cache_k/cache_v:
     persistable paged caches [B, H, S_max, D]; positions: [B, 1] int —
-    each sequence's length before this token. The updated caches are
-    written BACK INTO the cache vars (the single read+write op shape the
-    donation proof needs), and the attended context [B, H, 1, D] is
-    returned. scale=0.0 means 1/sqrt(D)."""
+    each sequence's length before this chunk. Query row i attends keys at
+    positions < pos + i + 1 (causal within the chunk). ``slot_mask``
+    [B, 1] (optional) keeps un-masked sequences' caches bit-untouched —
+    the chunked-prefill / speculative dispatches run a subset of slots.
+    The updated caches are written BACK INTO the cache vars (the single
+    read+write op shape the donation proof needs), and the attended
+    context [B, H, C, D] is returned. scale=0.0 means 1/sqrt(D)."""
     helper = LayerHelper("fused_decode_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": q, "KNew": k_new, "VNew": v_new,
+              "CacheK": cache_k, "CacheV": cache_v,
+              "Positions": positions}
+    if slot_mask is not None:
+        inputs["SlotMask"] = slot_mask
     helper.append_op(
         "fused_decode_attention",
-        inputs={"Q": q, "KNew": k_new, "VNew": v_new,
-                "CacheK": cache_k, "CacheV": cache_v,
-                "Positions": positions},
+        inputs=inputs,
         outputs={"Out": out, "CacheKOut": cache_k, "CacheVOut": cache_v},
         attrs={"scale": float(scale), "page_size": int(page_size)})
     return out
@@ -964,6 +972,25 @@ def sequence_gather(x, index, name=None):
     helper.append_op("sequence_gather", inputs={"X": x, "Index": index},
                      outputs={"Out": out})
     return out
+
+
+def spec_accept(sampled, drafts, start, name=None):
+    """Speculative-decoding accept rule (ops/generation.py): from the
+    target's per-position tokens ``sampled`` [B, k] and the draft's
+    proposals ``drafts`` [B, k-1], accept the longest agreeing prefix m
+    plus the target's bonus token. Returns ``(accept_len [B,1],
+    new_tok [B,1], new_pos [B,1])`` — all int64; ``new_pos = start + m +
+    1`` is the committed sequence length."""
+    helper = LayerHelper("spec_accept", name=name)
+    accept = helper.create_variable_for_type_inference("int64")
+    new_tok = helper.create_variable_for_type_inference("int64")
+    new_pos = helper.create_variable_for_type_inference("int64")
+    helper.append_op("spec_accept",
+                     inputs={"Sampled": sampled, "Drafts": drafts,
+                             "Start": start},
+                     outputs={"AcceptLen": accept, "NewTok": new_tok,
+                              "NewPos": new_pos})
+    return accept, new_tok, new_pos
 
 
 def sample_token(logits, strategy="greedy", temperature=1.0, top_k=0,

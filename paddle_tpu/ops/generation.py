@@ -29,8 +29,12 @@ from .. import flags
 from ..core.types import jnp_dtype
 
 
-def _route_decode(s_max: int, page_size: int) -> str:
-    """'pallas' | 'pallas-interpret' | 'primitive' for a decode shape."""
+def _route_decode(s_max: int, page_size: int, q_len: int = 1) -> str:
+    """'pallas' | 'pallas-interpret' | 'primitive' for a decode/chunk
+    shape. ``q_len`` > 1 is the chunked-prefill / speculative-verify
+    chunk; the kernel rides one 8-row sublane tile, so chunks past 8
+    rows fall back to the primitive path (never an error — the chunk
+    size is a scheduling knob, not a hardware contract)."""
     from ..kernels import classify_shapes
 
     mode = flags.flag("use_flash_attention")
@@ -43,6 +47,8 @@ def _route_decode(s_max: int, page_size: int) -> str:
                 f"FLAGS_use_flash_attention=always but the decode shape "
                 f"has no kernel tiling: {reason}")
         return "primitive"
+    if q_len > 8:
+        return "primitive"
     if jax.default_backend() == "tpu":
         return "pallas"
     return "pallas-interpret" if mode == "always" else "primitive"
@@ -52,49 +58,61 @@ def _route_decode(s_max: int, page_size: int) -> str:
     "fused_decode_attention",
     inputs=[IOSpec("Q"), IOSpec("KNew"), IOSpec("VNew"),
             IOSpec("CacheK"), IOSpec("CacheV"),
-            IOSpec("Positions", no_grad=True)],
+            IOSpec("Positions", no_grad=True),
+            IOSpec("SlotMask", optional=True, no_grad=True)],
     outputs=["Out", "CacheKOut", "CacheVOut"],
     attrs={"scale": 0.0, "page_size": 128},
     grad=None)
 def _fused_decode_attention(ctx, ins, attrs):
-    """One autoregressive decode step, epilogue fused:
+    """One autoregressive decode/verify chunk, epilogue fused:
 
-    1. append this step's K/V rows (``KNew``/``VNew`` [B, H, 1, D]) into
-       the paged caches ([B, H, S_max, D]) at per-sequence ``Positions``
-       ([B, 1] int — the sequence length BEFORE this token);
-    2. attend the single query row against the updated cache with a
-       per-sequence length mask (valid keys: positions < pos + 1).
+    1. append this chunk's K/V rows (``KNew``/``VNew`` [B, H, C, D],
+       C = q_len; C == 1 is the classic decode step) into the paged
+       caches ([B, H, S_max, D]) at per-sequence ``Positions`` ([B, 1]
+       int — the sequence length BEFORE this chunk), one row at a time
+       with per-row clamping onto the last cache row;
+    2. attend the C query rows against the updated cache with a
+       per-sequence, per-row causal length mask (query row i sees keys
+       at positions < pos + i + 1 — its own K row and everything before,
+       never a later chunk row).
 
+    ``SlotMask`` [B, 1] (optional) keeps un-masked sequences' caches
+    bit-untouched — the chunked-prefill and speculative-verify dispatches
+    run a subset of slots while their neighbours keep decoding.
     ``CacheKOut``/``CacheVOut`` are the updated caches — program builders
     point them back at the cache vars, making this the one op that reads
     and writes them (the donation-proof shape, see module docstring).
     Retired sequences whose position saturates past S_max - 1 clamp onto
-    the last row (XLA dynamic_update_slice semantics) and their output is
-    garbage by design — the serving layer discards it.
+    the last row and their output is garbage by design — the serving
+    layer discards it (the last row is never inside a live length mask).
     """
     from ..kernels import (decode_attention_reference, flash_attention_decode,
-                           paged_kv_append)
+                           paged_kv_append_rows)
 
     q, kn, vn = x(ins, "Q"), x(ins, "KNew"), x(ins, "VNew")
     ck, cv = x(ins, "CacheK"), x(ins, "CacheV")
     pos = x(ins, "Positions")
+    smask = x(ins, "SlotMask")
     B, H, q_len, D = q.shape
-    if q_len != 1:
+    if q_len < 1:
         raise ValueError(
-            f"fused_decode_attention: q_len must be 1 (the decode step), "
-            f"got {q_len}; use fused_multihead_attention for prefill")
+            f"fused_decode_attention: q_len must be >= 1, got {q_len}")
     S = ck.shape[2]
     page = int(attrs.get("page_size") or 128)
     scale = attrs["scale"] or float(D) ** -0.5
     pos_b = pos.reshape(B).astype(jnp.int32)
-    ck2 = paged_kv_append(ck, kn, pos_b)
-    cv2 = paged_kv_append(cv, vn, pos_b)
+    ck2 = paged_kv_append_rows(ck, kn, pos_b)
+    cv2 = paged_kv_append_rows(cv, vn, pos_b)
+    if smask is not None:
+        m = (smask.reshape(B) > 0).reshape((B, 1, 1, 1))
+        ck2 = jnp.where(m, ck2, ck)
+        cv2 = jnp.where(m, cv2, cv)
     lengths = jnp.minimum(pos_b + 1, S)
 
-    q3 = q.reshape(B * H, 1, D)
+    q3 = q.reshape(B * H, q_len, D)
     k3 = ck2.reshape(B * H, S, D)
     v3 = cv2.reshape(B * H, S, D)
-    route = _route_decode(S, page)
+    route = _route_decode(S, page, q_len=q_len)
     if route == "primitive":
         o = decode_attention_reference(q3, k3, v3,
                                        jnp.repeat(lengths, H, axis=0), scale)
@@ -102,7 +120,7 @@ def _fused_decode_attention(ctx, ins, attrs):
         o = flash_attention_decode(
             q3, k3, v3, lengths, scale=scale, num_heads=H,
             page_size=page, interpret=(route == "pallas-interpret"))
-    return {"Out": [o.reshape(B, H, 1, D)],
+    return {"Out": [o.reshape(B, H, q_len, D)],
             "CacheKOut": [ck2], "CacheVOut": [cv2]}
 
 
@@ -133,6 +151,52 @@ def _kv_cache_append(ctx, ins, attrs):
         m = (mask.reshape(B) > 0).reshape((B,) + (1,) * (cache.ndim - 1))
         upd = jnp.where(m, upd, cache)
     return {"Out": [upd]}
+
+
+@register_op(
+    "spec_accept",
+    inputs=[IOSpec("Sampled", no_grad=True),
+            IOSpec("Drafts", no_grad=True),
+            IOSpec("Start", no_grad=True)],
+    outputs=["AcceptLen", "NewTok", "NewPos"],
+    attrs={},
+    grad=None)
+def _spec_accept(ctx, ins, attrs):
+    """Speculative-decoding accept rule, in-program (no host round-trip
+    between verify and state commit). ``Sampled`` [B, k] int64 holds the
+    target model's token at every chunk position: ``Sampled[:, i]`` is
+    the token the target emits AFTER seeing the chunk's first ``i + 1``
+    tokens. ``Drafts`` [B, k-1] int64 are the draft's proposals (the
+    chunk tokens 1..k-1). ``Start`` [B, 1] int is the sequence length
+    before the chunk.
+
+    The longest agreeing prefix ``m = |{j : Drafts[:, :j] ==
+    Sampled[:, :j]}|`` accepts ``m`` draft tokens plus the target's own
+    bonus token ``Sampled[:, m]`` (the in-program fallback: at m == 0
+    the dispatch still emits one token, exactly the non-speculative
+    step). Outputs: ``AcceptLen`` [B, 1] = m, ``NewTok`` [B, 1] =
+    ``Sampled[:, m]``, ``NewPos`` [B, 1] = ``Start + m + 1`` (the new
+    sequence length: the chunk's first token plus m accepted drafts are
+    now committed cache rows; rejected rows sit past the length mask and
+    are overwritten by the next dispatch)."""
+    s, d = x(ins, "Sampled"), x(ins, "Drafts")
+    start = x(ins, "Start")
+    B, k = s.shape
+    if d.shape != (B, k - 1):
+        raise ValueError(
+            f"spec_accept: Drafts must be [B, k-1] = [{B}, {k - 1}] for "
+            f"Sampled [B, k] = {tuple(s.shape)}, got {tuple(d.shape)}")
+    i64 = jnp_dtype("int64")
+    if k == 1:
+        m = jnp.zeros((B,), jnp.int32)
+    else:
+        agree = (s[:, :k - 1] == d).astype(jnp.int32)
+        m = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)
+    new_tok = jnp.take_along_axis(s, m[:, None].astype(jnp.int32), axis=1)
+    new_pos = start.reshape(B, 1).astype(i64) + m[:, None] + 1
+    return {"AcceptLen": [m[:, None].astype(i64)],
+            "NewTok": [new_tok.astype(i64)],
+            "NewPos": [new_pos.astype(i64)]}
 
 
 @register_op(

@@ -451,6 +451,11 @@ class ServingEngine:
         self._scope = scope if scope is not None else Scope()
         self._exe = executor or Executor(place)
         self.config = (config or ServingConfig()).resolve()
+        # injectable monotonic clock (the autoscaler's `_now` idiom):
+        # every pressure/degradation/deadline-sweep window reads THIS, so
+        # tests drive the sustain windows deterministically instead of
+        # racing wall-clock sleeps against the dispatch thread
+        self._now = time.monotonic
 
         self._lock = _monitor.make_lock("ServingEngine._lock")
         self._work = _monitor.make_condition("ServingEngine._work",
@@ -655,7 +660,7 @@ class ServingEngine:
             self._account("rejected_fault")
             self._finish_request(req, "rejected_fault", e)
             raise
-        now = time.monotonic()
+        now = self._now()
         with self._lock:
             if not self._running:
                 self._acct["rejected_stopped"] += 1
@@ -712,7 +717,7 @@ class ServingEngine:
         tenant = str(tenant).strip() if tenant is not None else ""
         req = _Request(seq=seq, feed=vals, nrows=nrows, sig=sig,
                        priority=int(priority), deadline=dl,
-                       submitted=time.monotonic(), future=ServingFuture(),
+                       submitted=self._now(), future=ServingFuture(),
                        tenant=tenant or DEFAULT_TENANT)
         if self.config.bisect_depth > 0 and self._quarantine:
             # the fingerprint is only needed eagerly for the admission
@@ -919,14 +924,14 @@ class ServingEngine:
                     # periodic wake even when idle: deadline sweeps and
                     # degradation recovery must not wait for traffic
                     self._work.wait(timeout=0.05)
-                    self._sweep_expired_locked(time.monotonic())
-                    self._update_pressure_locked(time.monotonic())
+                    self._sweep_expired_locked(self._now())
+                    self._update_pressure_locked(self._now())
                 if not self._running and (not self._queue or not self._drain):
                     leftovers, self._queue = self._queue, []
                     self._gauge_depth_locked()
                 else:
                     leftovers = None
-                    now = time.monotonic()
+                    now = self._now()
                     self._sweep_expired_locked(now)
                     self._update_pressure_locked(now)
                     batch = self._take_batch_locked(now)
@@ -999,15 +1004,15 @@ class ServingEngine:
             try:
                 until = now + self.config.batch_window_s
                 while True:
-                    left = until - time.monotonic()
+                    left = until - self._now()
                     if left <= 0:
                         break
                     self._work.wait(timeout=left)
                     if sum(r.nrows for r in self._queue
                            if r.sig == sig) >= cap:
                         break
-                self._sweep_expired_locked(time.monotonic())
-                return self._take_batch_locked(time.monotonic())
+                self._sweep_expired_locked(self._now())
+                return self._take_batch_locked(self._now())
             finally:
                 self._windowed = False
         self._queue[:] = rest
@@ -1358,7 +1363,7 @@ class ServingEngine:
         return h.hexdigest()[:32]
 
     def _distribute(self, batch, outs, padded) -> None:
-        now = time.monotonic()
+        now = self._now()
         offset = 0
         for r in batch:
             res = []
@@ -1450,7 +1455,7 @@ class ServingEngine:
         # SLO + tenant accounting, once per terminal outcome (this method
         # is the single chokepoint every settle path funnels through).
         # Both stores are leaf-locked, never the engine lock.
-        elapsed = time.monotonic() - r.submitted
+        elapsed = self._now() - r.submitted
         completed = outcome == "completed"
         self._slo.observe(r.priority, elapsed if completed else None,
                           error=not completed)

@@ -21,6 +21,25 @@ work into the two phases of ``models/gpt.py``:
   leaking into a cache key as KV grows) into a counted, logged event and
   a CI-gated metric.
 
+ISSUE 20 adds two composable phases on the same slot/bucket discipline:
+
+* **prefix reuse + chunked prefill** — admission first matches the
+  prompt against the content-hash :class:`~.prefix_cache.PrefixCache`;
+  matched whole pages are COPIED into the slot's KV rows and only the
+  suffix is prefilled, one ``prefill_chunk``-token slot-masked slice per
+  scheduler iteration, interleaved with the resident decode chunks (the
+  same path admits prompts longer than the largest bucket). The final
+  slice samples the first token in-program and flips the slot's decode
+  gate (``gpt_gen_active``); completed prefills publish their pages.
+* **speculative decoding** (``GenerationConfig.speculative``) — each
+  round a host-side draft (prompt-lookup n-gram by default, swappable
+  via ``engine.draft_fn``) proposes ``spec_k - 1`` tokens and the target
+  verifies the whole chunk in ONE dispatch; ``spec_accept`` commits the
+  longest agreeing prefix + bonus token in-program. Greedy speculative
+  output is bit-exact vs non-speculative decode — the verify scores each
+  position with the identical model and context, so acceptance never
+  changes WHAT is generated, only how many dispatches it takes.
+
 Contract (inherited, unchanged): every submitted request reaches EXACTLY
 ONE terminal outcome. Streamed tokens are partial results, not outcomes —
 a request that expires mid-stream settles ``DeadlineExceeded`` (typed)
@@ -67,6 +86,14 @@ class GenerationConfig:
     # also the deadline-enforcement granularity
     max_new_tokens_default: int = 16
     eos_id: int = -1               # < 0: no stop token
+    # -- prefix-reuse KV cache (ISSUE 20, tentpole leg a) ----------------
+    prefix_cache: bool = True      # content-hash prompt pages, share them
+    prefix_cache_pages: int = 64   # LRU bound on stored pages
+    # -- chunked prefill -------------------------------------------------
+    chunked_prefill: bool = True   # admit long/cold prompts slice by
+    # slice between decode chunks instead of one monolithic prefill
+    # -- speculative decoding (tentpole leg b) ---------------------------
+    speculative: bool = False      # draft k tokens, verify in one dispatch
 
     def resolve(self) -> "GenerationConfig":
         if self.decode_chunk < 1:
@@ -75,17 +102,25 @@ class GenerationConfig:
         if self.max_new_tokens_default < 1:
             raise ValueError(f"generation: max_new_tokens_default must be "
                              f">= 1, got {self.max_new_tokens_default}")
+        if self.prefix_cache_pages < 1:
+            raise ValueError(f"generation: prefix_cache_pages must be >= 1, "
+                             f"got {self.prefix_cache_pages}")
         return self
 
 
 @dataclasses.dataclass
 class _GenRequest(_Request):
     prompt: np.ndarray = None      # [L] int64
-    bucket: int = 0                # prompt bucket (padded length)
+    bucket: int = 0                # prompt bucket (0: chunked-only admit)
     max_new: int = 1
     slot: int = -1                 # assigned batch slot, -1 while queued
     emitted: int = 0
     out_tokens: List[int] = dataclasses.field(default_factory=list)
+    # chunked-prefill / prefix-reuse bookkeeping (dispatcher thread only)
+    chunked: bool = False          # admitted via chunk slices
+    prefilled: bool = False        # decode-eligible (prefill complete)
+    prefix_rows: int = 0           # KV rows copied in from the prefix cache
+    next_off: int = 0              # next prompt offset to prefill
 
 
 class GenerativeEngine(ServingEngine):
@@ -113,6 +148,33 @@ class GenerativeEngine(ServingEngine):
         # exists; any LATER cache growth on the same key is a recompile
         self._compiled_buckets: Dict[tuple, bool] = {}
         self.decode_recompiles = 0
+        # chunked prefill + speculative verify programs (absent on model
+        # dicts from before ISSUE 20 — every new path degrades to the
+        # bucket-prefill / plain-decode behaviour)
+        self._chunk = model.get("chunk")
+        self._verify = model.get("verify")
+        self._prefill_chunk = int(model.get("prefill_chunk") or
+                                  self._page_size)
+        self._spec_k = int(model.get("spec_k") or 0)
+        self._cache_names = sorted(
+            (n, "gpt_kv_v_" + n[len("gpt_kv_k_"):])
+            for n in model["state_vars"] if n.startswith("gpt_kv_k_"))
+        gc = self.gen_config
+        self._prefix_cache = None
+        if gc.prefix_cache and self._chunk is not None:
+            from .prefix_cache import PrefixCache
+            self._prefix_cache = PrefixCache(
+                self._page_size, capacity_pages=gc.prefix_cache_pages)
+        self._speculative = bool(
+            gc.speculative and self._verify is not None and self._spec_k >= 2)
+        # host-side draft proposer for speculative decoding: callable
+        # (history_tokens: np.ndarray, n: int) -> n proposed tokens.
+        # Default: prompt-lookup n-gram (see _ngram_draft). Swappable for
+        # tests and for a real draft model.
+        self.draft_fn = None
+        self.prefill_chunks = 0    # chunk slices dispatched (per request)
+        self.spec_chunks = 0       # verify dispatches
+        self.spec_accepted = 0     # draft tokens accepted in total
 
     # -- state lifecycle -------------------------------------------------
     def reset_generation_state(self) -> None:
@@ -167,8 +229,30 @@ class GenerativeEngine(ServingEngine):
                               scope=self._scope)
         self._note_compiles("decode", len(self._slots), self._program)
         compiled += 1
+        if self._use_chunked():
+            net = self._chunk
+            self._exe.run(net["main"], feed=self._chunk_feed([]),
+                          fetch_list=[net["first_token"].name],
+                          scope=self._scope)
+            self._note_compiles("chunk", self._prefill_chunk, net["main"])
+            compiled += 1
+        if self._speculative:
+            net = self._verify
+            self._exe.run(net["main"], feed=self._verify_feed([]),
+                          fetch_list=[net["accept_len"].name,
+                                      net["sampled"].name],
+                          scope=self._scope)
+            self._note_compiles("verify", self._spec_k, net["main"])
+            compiled += 1
         self.reset_generation_state()
         return compiled
+
+    def _use_chunked(self) -> bool:
+        """Chunked prefill is live when the model ships a chunk program
+        and either admission leg needs it (long-prompt slicing or the
+        prefix cache's suffix prefill)."""
+        return self._chunk is not None and (
+            self.gen_config.chunked_prefill or self._prefix_cache is not None)
 
     # -- submission ------------------------------------------------------
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
@@ -204,11 +288,17 @@ class GenerativeEngine(ServingEngine):
         prompt = prompt.astype(np.int64)
         L = int(prompt.shape[0])
         bucket = next((b for b in self._buckets if b >= L), None)
+        chunked = False
         if bucket is None:
-            raise ValueError(
-                f"serving: prompt length {L} exceeds the largest prompt "
-                f"bucket {max(self._buckets)}; split or truncate the "
-                f"prompt")
+            # past the largest bucket: chunked prefill admits it slice by
+            # slice (no bucket executable is ever built for this length)
+            if not (self._chunk is not None
+                    and self.gen_config.chunked_prefill):
+                raise ValueError(
+                    f"serving: prompt length {L} exceeds the largest "
+                    f"prompt bucket {max(self._buckets)}; split or "
+                    f"truncate the prompt (or enable chunked_prefill)")
+            bucket, chunked = 0, True
         max_new = int(max_new_tokens
                       if max_new_tokens is not None
                       else self.gen_config.max_new_tokens_default)
@@ -224,11 +314,13 @@ class GenerativeEngine(ServingEngine):
         dl = Deadline(budget, what=f"serving generation #{seq}") \
             if budget and budget > 0 else None
         tenant = str(tenant).strip() if tenant is not None else ""
-        req = _GenRequest(seq=seq, feed={}, nrows=1, sig=("gen", bucket),
+        req = _GenRequest(seq=seq, feed={}, nrows=1,
+                          sig=("gen", bucket or "chunk"),
                           priority=int(priority), deadline=dl,
-                          submitted=time.monotonic(), future=ServingFuture(),
+                          submitted=self._now(), future=ServingFuture(),
                           tenant=tenant or DEFAULT_TENANT,
-                          prompt=prompt, bucket=bucket, max_new=max_new)
+                          prompt=prompt, bucket=bucket, max_new=max_new,
+                          chunked=chunked)
         req.span = self._request_root(trace_parent, seq=seq,
                                       prompt_len=L, max_new=max_new,
                                       priority=int(priority))
@@ -243,8 +335,8 @@ class GenerativeEngine(ServingEngine):
                 while (self._running and not self._queue
                        and not any(r is not None for r in self._slots)):
                     self._work.wait(timeout=0.05)
-                    self._sweep_expired_locked(time.monotonic())
-                    self._update_pressure_locked(time.monotonic())
+                    self._sweep_expired_locked(self._now())
+                    self._update_pressure_locked(self._now())
                 active = [r for r in self._slots if r is not None]
                 stopping = not self._running and (
                     not self._drain or (not self._queue and not active))
@@ -253,7 +345,7 @@ class GenerativeEngine(ServingEngine):
                     self._slots = [None] * len(self._slots)
                     self._gauge_depth_locked()
                 else:
-                    now = time.monotonic()
+                    now = self._now()
                     self._sweep_expired_locked(now)
                     self._update_pressure_locked(now)
                     newcomers = self._refill_locked()
@@ -271,11 +363,20 @@ class GenerativeEngine(ServingEngine):
             # ones inside one dispatch
             self._current_batch = [r for r in self._slots if r is not None]
             if newcomers:
-                self._run_prefill(newcomers)
+                self._run_prefill(self._admit_newcomers(newcomers))
                 self._current_batch = [r for r in self._slots
                                        if r is not None]
-            if any(r is not None for r in self._slots):
-                self._run_decode_chunk()
+            # one chunk slice per pending chunked request per iteration,
+            # INTERLEAVED with the resident decode chunk below — a long
+            # cold prompt never stalls the decoders
+            if any(r is not None and r.chunked and not r.prefilled
+                   for r in self._slots):
+                self._run_chunk_slices()
+                self._current_batch = [r for r in self._slots
+                                       if r is not None]
+            if any(r is not None and r.prefilled for r in self._slots):
+                if not (self._speculative and self._run_spec_chunk()):
+                    self._run_decode_chunk()
                 self._current_batch = [r for r in self._slots
                                        if r is not None]
             self._gauge_kv_occupancy()
@@ -295,6 +396,306 @@ class GenerativeEngine(ServingEngine):
         if taken:
             self._gauge_depth_locked()
         return taken
+
+    # -- admission: prefix reuse + chunked prefill -----------------------
+    def _admit_newcomers(self,
+                         newcomers: List[_GenRequest]) -> List[_GenRequest]:
+        """Route just-seated requests: a prefix-cache hit copies the
+        matched pages into the slot and prefills ONLY the suffix via
+        chunk slices; an over-bucket prompt goes chunked from row 0;
+        everything else takes the classic bucket prefill (returned)."""
+        bucketed: List[_GenRequest] = []
+        for r in newcomers:
+            rows = 0
+            if self._prefix_cache is not None:
+                rows, entries = self._prefix_cache.match(r.prompt)
+                if _monitor.enabled():
+                    (_monitor.counter("serving_prefix_hits_total",
+                                      "requests that reused >= 1 cached "
+                                      "prefix page") if rows else
+                     _monitor.counter("serving_prefix_misses_total",
+                                      "requests with no cached prefix "
+                                      "page")).inc()
+                    if rows:
+                        _monitor.counter(
+                            "serving_prefix_pages_reused_total",
+                            "KV pages served from the prefix cache"
+                        ).inc(rows // self._page_size)
+                if rows:
+                    self._copy_in_prefix(r.slot, entries)
+                    r.prefix_rows, r.next_off, r.chunked = rows, rows, True
+                    continue
+            if r.chunked:
+                r.next_off = 0
+            else:
+                bucketed.append(r)
+        return bucketed
+
+    def _copy_in_prefix(self, slot: int, entries: List[dict]) -> None:
+        """Copy matched prefix pages into ``slot``'s KV rows. Copy-in (not
+        aliasing) is the CoW story: the resident owns its rows outright,
+        so later divergence or store eviction can never corrupt it."""
+        P = self._page_size
+        for li, (nk, nv) in enumerate(self._cache_names):
+            for name, kv in ((nk, "k"), (nv, "v")):
+                arr = np.array(self._scope.find_var(name))
+                for i, e in enumerate(entries):
+                    arr[slot, :, i * P:(i + 1) * P, :] = e[kv][li]
+                self._scope.set_var(name, arr)
+
+    def _publish_pages(self, r: _GenRequest) -> None:
+        """After ``r``'s prefill completes, publish COPIES of its whole-
+        page prompt rows under their chain hashes (cheap no-op for pages
+        already stored)."""
+        if self._prefix_cache is None:
+            return
+        P, slot = self._page_size, r.slot
+
+        def page_rows(i):
+            ks, vs = [], []
+            for nk, nv in self._cache_names:
+                ks.append(np.array(np.asarray(
+                    self._scope.find_var(nk))[slot, :, i * P:(i + 1) * P, :]))
+                vs.append(np.array(np.asarray(
+                    self._scope.find_var(nv))[slot, :, i * P:(i + 1) * P, :]))
+            return ks, vs
+
+        self._prefix_cache.insert(r.prompt, page_rows)
+        if _monitor.enabled():
+            _monitor.gauge(
+                "serving_prefix_pages",
+                "KV pages resident in the prefix cache").set(
+                float(len(self._prefix_cache)))
+
+    def _deactivate_slot(self, slot: int) -> None:
+        """Host-side decode-gate clear on retire: the slot's ``active``
+        flag goes 0 so later decode/verify dispatches leave its state and
+        cache rows untouched until the next admission re-arms it."""
+        cur = self._scope.find_var("gpt_gen_active")
+        if cur is None:
+            return
+        arr = np.array(cur)
+        arr[slot, 0] = 0.0
+        self._scope.set_var("gpt_gen_active", arr)
+
+    # -- chunked prefill -------------------------------------------------
+    def _chunk_feed(self, pending: Sequence[_GenRequest]) -> dict:
+        B, C = len(self._slots), self._prefill_chunk
+        feed = {
+            "chunk_ids": np.zeros((B, C), np.int64),
+            "chunk_pos": np.zeros((B, C), np.int64),
+            "chunk_start": np.zeros((B, 1), np.int64),
+            "chunk_len": np.ones((B, 1), np.int64),
+            "slot_mask": np.zeros((B, 1), np.float32),
+            "sample_mask": np.zeros((B, 1), np.float32),
+        }
+        for r in pending:
+            off, L = r.next_off, len(r.prompt)
+            take = r.prompt[off:off + C]
+            n = len(take)
+            feed["chunk_ids"][r.slot, :n] = take
+            if n < C:
+                feed["chunk_ids"][r.slot, n:] = take[-1]
+            feed["chunk_pos"][r.slot] = np.clip(
+                off + np.arange(C), 0, self._max_seq - 1)
+            feed["chunk_start"][r.slot, 0] = off
+            feed["chunk_len"][r.slot, 0] = n
+            feed["slot_mask"][r.slot, 0] = 1.0
+            if off + n >= L:
+                feed["sample_mask"][r.slot, 0] = 1.0
+        return feed
+
+    def _run_chunk_slices(self) -> None:
+        """One prefill slice for EVERY pending chunked request, batched
+        into a single slot-masked dispatch. A prompt's final slice samples
+        its first token in-program and flips the slot's decode gate."""
+        pending = [r for r in self._slots
+                   if r is not None and r.chunked and not r.prefilled]
+        live: List[_GenRequest] = []
+        for r in pending:
+            if r.deadline is not None and r.deadline.expired:
+                self._retire(r)
+                self._settle_error(
+                    r, "deadline_exceeded",
+                    DeadlineExceeded(r.deadline.what, r.deadline.budget_s,
+                                     r.deadline.elapsed()),
+                    dispatched=True)
+            else:
+                live.append(r)
+        if not live:
+            return
+        net = self._chunk
+        span = _trace.NOOP_SPAN
+        if _trace.enabled():
+            span = _trace.root_span(
+                "serving.prefill_chunk", requests=len(live),
+                request_traces=",".join(r.span.trace_id for r in live))
+        try:
+            _faults.fault_point("batch_dispatch")
+            feed = self._chunk_feed(live)
+            t0 = time.perf_counter()
+            with _trace.attach(span):
+                outs = self._exe.run(net["main"], feed=feed,
+                                     fetch_list=[net["first_token"].name],
+                                     scope=self._scope)
+            dt = time.perf_counter() - t0
+        except _faults.InjectedFault as e:
+            span.end(error=e)
+            self._fail_group(live, e, phase="prefill_chunk")
+            return
+        except Exception as e:
+            span.end(error=e)
+            self._fail_all_resident(e, phase="prefill_chunk")
+            return
+        span.end()
+        self._note_compiles("chunk", self._prefill_chunk, net["main"])
+        self.prefill_chunks += len(live)
+        if _monitor.enabled():
+            _monitor.counter(
+                "serving_prefill_chunks_total",
+                "chunked-prefill slices dispatched (per request)"
+            ).inc(len(live))
+            _monitor.histogram(
+                "serving_prefill_seconds",
+                "wall time of one slot-masked prefill dispatch").observe(dt)
+        first = np.asarray(outs[0]).reshape(len(self._slots))
+        C = self._prefill_chunk
+        for r in live:
+            n = min(C, len(r.prompt) - r.next_off)
+            r.next_off += n
+            if r.next_off < len(r.prompt):
+                continue
+            r.prefilled = True
+            self._publish_pages(r)
+            if _monitor.enabled():
+                _monitor.histogram(
+                    "serving_first_token_seconds",
+                    "submit-to-first-token latency (prefill + queue)"
+                ).observe(self._now() - r.submitted)
+            self._emit(r, [int(first[r.slot])], dt,
+                       record_intertoken=False)
+
+    # -- speculative decoding --------------------------------------------
+    def _ngram_draft(self, hist: np.ndarray, n: int) -> List[int]:
+        """Prompt-lookup drafting (model-free): find the most recent
+        earlier occurrence of the last token and propose the tokens that
+        followed it; pad by repeating. A wrong draft costs only its
+        rejected verify rows — correctness rides on the verify dispatch,
+        never the proposer."""
+        last = int(hist[-1])
+        prev = np.nonzero(hist[:-1] == last)[0]
+        cand = hist[int(prev[-1]) + 1:int(prev[-1]) + 1 + n] \
+            if prev.size else hist[:0]
+        toks = [int(t) for t in cand]
+        while len(toks) < n:
+            toks.append(toks[-1] if toks else last)
+        return toks
+
+    def _draft(self, r: _GenRequest, n: int) -> List[int]:
+        hist = np.concatenate(
+            [r.prompt, np.asarray(r.out_tokens, np.int64)]) \
+            if r.out_tokens else r.prompt
+        if self.draft_fn is not None:
+            toks = [int(t) for t in self.draft_fn(hist, n)]
+            if len(toks) != n:
+                raise ValueError(
+                    f"serving: draft_fn returned {len(toks)} tokens, "
+                    f"expected {n}")
+            return toks
+        return self._ngram_draft(hist, n)
+
+    def _verify_feed(self, active: Sequence[_GenRequest]) -> dict:
+        B, k = len(self._slots), self._spec_k
+        feed = {
+            "chunk_ids": np.zeros((B, k), np.int64),
+            "chunk_pos": np.zeros((B, k), np.int64),
+            "chunk_start": np.zeros((B, 1), np.int64),
+            "slot_mask": np.zeros((B, 1), np.float32),
+            "draft_ids": np.zeros((B, k - 1), np.int64),
+        }
+        for r in active:
+            pos = len(r.prompt) + r.emitted - 1   # committed cache rows
+            drafts = self._draft(r, k - 1)
+            feed["chunk_ids"][r.slot, 0] = r.out_tokens[-1]
+            feed["chunk_ids"][r.slot, 1:] = drafts
+            feed["chunk_pos"][r.slot] = np.clip(
+                pos + np.arange(k), 0, self._max_seq - 1)
+            feed["chunk_start"][r.slot, 0] = pos
+            feed["slot_mask"][r.slot, 0] = 1.0
+            feed["draft_ids"][r.slot] = drafts
+        return feed
+
+    def _run_spec_chunk(self) -> bool:
+        """One draft-then-verify round for every decode-eligible resident:
+        the target scores the whole k-token chunk in ONE dispatch and
+        commits the longest agreeing prefix + bonus token in-program.
+        Returns False (caller falls back to the plain decode chunk) when
+        any resident is too near its KV capacity for a full chunk."""
+        active = [r for r in self._slots if r is not None and r.prefilled]
+        k = self._spec_k
+        if not active:
+            return False
+        for r in active:
+            if len(r.prompt) + r.emitted - 1 + k > self._max_seq:
+                return False
+        span = _trace.NOOP_SPAN
+        if _trace.enabled():
+            span = _trace.root_span(
+                "serving.spec_verify", k=k, requests=len(active),
+                request_traces=",".join(r.span.trace_id for r in active))
+        net = self._verify
+        try:
+            _faults.fault_point("batch_dispatch")
+            feed = self._verify_feed(active)
+            t0 = time.perf_counter()
+            with _trace.attach(span):
+                outs = self._exe.run(
+                    net["main"], feed=feed,
+                    fetch_list=[net["accept_len"].name,
+                                net["sampled"].name],
+                    scope=self._scope)
+            dt = time.perf_counter() - t0
+        except _faults.InjectedFault as e:
+            span.end(error=e)
+            self._fail_group(active, e, phase="spec_verify")
+            return True
+        except Exception as e:
+            span.end(error=e)
+            self._fail_all_resident(e, phase="spec_verify")
+            return True
+        span.end()
+        self._note_compiles("verify", k, net["main"])
+        self.spec_chunks += 1
+        accept = np.asarray(outs[0]).reshape(len(self._slots))
+        sampled = np.asarray(outs[1]).reshape(len(self._slots), k)
+        if _monitor.enabled():
+            _monitor.histogram(
+                "serving_decode_chunk_seconds",
+                "wall time of one chained decode chunk").observe(dt)
+        for r in active:
+            if r.deadline is not None and r.deadline.expired:
+                self._retire(r)
+                self._settle_error(
+                    r, "deadline_exceeded",
+                    DeadlineExceeded(r.deadline.what, r.deadline.budget_s,
+                                     r.deadline.elapsed()),
+                    dispatched=True)
+                continue
+            m = int(accept[r.slot])
+            self.spec_accepted += m
+            if _monitor.enabled():
+                _monitor.histogram(
+                    "serving_spec_accepted_len",
+                    "draft tokens accepted per verify chunk (0..k-1; the "
+                    "bonus token is on top)").observe(float(m))
+            take = sampled[r.slot, :m + 1][:r.max_new - r.emitted]
+            eos = self.gen_config.eos_id
+            if eos >= 0:
+                hits = np.nonzero(take == eos)[0]
+                if hits.size:
+                    take = take[:int(hits[0]) + 1]
+            self._emit(r, [int(t) for t in take], dt)
+        return True
 
     # -- prefill ---------------------------------------------------------
     def _prefill_feed(self, bucket: int,
@@ -362,6 +763,9 @@ class GenerativeEngine(ServingEngine):
                 ).observe(dt)
             first = np.asarray(outs[0]).reshape(len(self._slots))
             for r in reqs:
+                r.prefilled = True
+                r.next_off = len(r.prompt)
+                self._publish_pages(r)
                 if r.deadline is not None and r.deadline.expired:
                     self._retire(r)
                     self._settle_error(
@@ -375,7 +779,7 @@ class GenerativeEngine(ServingEngine):
                     _monitor.histogram(
                         "serving_first_token_seconds",
                         "submit-to-first-token latency (prefill + queue)"
-                    ).observe(time.monotonic() - r.submitted)
+                    ).observe(self._now() - r.submitted)
                 # the first token's cost is the FIRST-TOKEN histogram's
                 # story — it must not pollute the inter-token latency
                 self._emit(r, [int(first[r.slot])], dt,
@@ -383,7 +787,10 @@ class GenerativeEngine(ServingEngine):
 
     # -- decode ----------------------------------------------------------
     def _run_decode_chunk(self) -> None:
-        active = [r for r in self._slots if r is not None]
+        # only decode-eligible residents: slots mid-chunked-prefill keep
+        # their in-program decode gate (``gpt_gen_active``) at 0, so the
+        # dispatch leaves their state and cache rows bit-untouched
+        active = [r for r in self._slots if r is not None and r.prefilled]
         steps = self.gen_config.decode_chunk
         span = _trace.NOOP_SPAN
         if _trace.enabled():
@@ -466,7 +873,7 @@ class GenerativeEngine(ServingEngine):
             done = True
         if done:
             self._retire(r)
-            latency = time.monotonic() - r.submitted
+            latency = self._now() - r.submitted
             with self._lock:
                 self._acct["completed"] += 1
                 self._dispatched -= 1
@@ -487,6 +894,7 @@ class GenerativeEngine(ServingEngine):
     def _retire(self, r: _GenRequest) -> None:
         if 0 <= r.slot < len(self._slots) and self._slots[r.slot] is r:
             self._slots[r.slot] = None
+            self._deactivate_slot(r.slot)
 
     def _fail_group(self, reqs: List[_GenRequest], err: BaseException,
                     phase: str) -> None:
@@ -580,8 +988,10 @@ class GenerativeEngine(ServingEngine):
 
     def generation_stats(self) -> dict:
         """Decode-side snapshot for reports: resident slots, compiled
-        (phase, bucket) executables, recompiles."""
+        (phase, bucket) executables, recompiles, prefix-cache and
+        speculative-decoding counters."""
         resident = [r.seq for r in self._slots if r is not None]
+        pc = self._prefix_cache
         return {
             "slots": len(self._slots),
             "resident": resident,
@@ -591,4 +1001,13 @@ class GenerativeEngine(ServingEngine):
             "max_seq": self._max_seq,
             "page_size": self._page_size,
             "prompt_buckets": list(self._buckets),
+            "prefill_chunk": self._prefill_chunk,
+            "prefill_chunks": self.prefill_chunks,
+            "prefix_cache": pc.stats() if pc is not None else None,
+            "speculative": {
+                "enabled": self._speculative,
+                "k": self._spec_k if self._speculative else 0,
+                "chunks": self.spec_chunks,
+                "accepted_tokens": self.spec_accepted,
+            },
         }

@@ -87,8 +87,10 @@ def test_decode_kernel_refuses_bad_shapes():
         flash_attention_decode(q, jnp.zeros((2, 33, 16)),
                                jnp.zeros((2, 33, 16)), np.array([1, 1]),
                                num_heads=1, page_size=8, interpret=True)
-    with pytest.raises(ValueError, match="q_len=1"):
-        flash_attention_decode(jnp.zeros((2, 2, 16)),
+    # q_len 2..8 is the legal chunk range since ISSUE 20; past one
+    # sublane tile the kernel refuses (the op routes to the primitive)
+    with pytest.raises(ValueError, match="q_len<=8"):
+        flash_attention_decode(jnp.zeros((2, 9, 16)),
                                jnp.zeros((2, 32, 16)),
                                jnp.zeros((2, 32, 16)), np.array([1, 1]),
                                num_heads=1, page_size=8, interpret=True)
@@ -343,7 +345,9 @@ def _engine(serving_net, **gen_kw):
 def test_generative_engine_end_to_end(serving_net):
     monitor.reset()
     eng = _engine(serving_net)
-    assert eng.warm_up() == 3     # two prefill buckets + one decode
+    # two prefill buckets + one decode + the chunked-prefill program
+    # (prefix cache + chunked prefill are on by default since ISSUE 20)
+    assert eng.warm_up() == 4
     rng = np.random.RandomState(3)
     with eng:
         futs = [eng.submit(rng.randint(1, 128, 3 + i % 9),
@@ -362,7 +366,7 @@ def test_generative_engine_end_to_end(serving_net):
     assert eng.decode_recompiles == 0
     stats = eng.generation_stats()
     assert set(stats["compiled_buckets"]) == {"prefill:8", "prefill:16",
-                                              "decode:2"}
+                                              "decode:2", "chunk:8"}
     assert monitor.metric_value("serving_decode_tokens_total", 0.0) \
         == sum(2 + i % 4 for i in range(6))
     it = monitor.metric_value("serving_intertoken_seconds", default=None)
@@ -485,8 +489,11 @@ def test_warm_up_refused_on_running_engine(serving_net):
 
 def test_submit_validation(serving_net):
     eng = _engine(serving_net)
+    # over-bucket prompts only refuse once chunked prefill is off
+    # (default-on since ISSUE 20 they admit slice by slice instead)
+    cold = _engine(serving_net, chunked_prefill=False, prefix_cache=False)
     with pytest.raises(ValueError, match="exceeds the largest prompt"):
-        eng._build_gen_request(np.arange(40), 4, 0, None)
+        cold._build_gen_request(np.arange(40), 4, 0, None)
     with pytest.raises(ValueError, match="KV capacity"):
         eng._build_gen_request(np.arange(1, 9), 60, 0, None)
     with pytest.raises(ValueError, match="non-empty 1-D"):

@@ -298,36 +298,68 @@ def test_breaker_isolation_other_bucket_keeps_serving():
 # graceful degradation + recovery
 # ---------------------------------------------------------------------------
 
+def _degradation_harness(eng):
+    """Deterministic degradation driver (deflaked at ISSUE 20, see
+    KNOWN_FAILURES.md): the sustain windows read the engine's injectable
+    clock (``eng._now``, the autoscaler idiom) advanced by a test-owned
+    offset, and the dispatcher is parked by gating ``_take_batch_locked``
+    so queued requests create pressure for exactly as long as the test
+    wants — no wall-clock sleeps racing the dispatch thread. Returns
+    ``(advance, release)``."""
+    off = [0.0]
+    eng._now = lambda: time.monotonic() + off[0]
+    hold = [True]
+    orig_take = eng._take_batch_locked
+    # the gate yields once the engine stops, so a failing assert can
+    # never leave the dispatcher spinning on an undrainable queue
+    eng._take_batch_locked = \
+        lambda now: [] if hold[0] and eng._running else orig_take(now)
+
+    def advance(seconds):
+        off[0] += seconds
+
+    def release():
+        hold[0] = False
+
+    return advance, release
+
+
+def _wait_health(eng, key, want, timeout=10.0):
+    until = time.monotonic() + timeout
+    while time.monotonic() < until:
+        if eng.health()[key] == want:
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"health[{key!r}] never became {want!r}")
+
+
 def test_degradation_sheds_priority_and_recovers():
-    eng = _engine(max_batch=4, queue_depth=2, batch_window_s=0.4,
-                  degrade_after_s=0.0, recover_after_s=0.05,
-                  degraded_min_priority=1)
+    eng = _engine(max_batch=4, queue_depth=3, degrade_after_s=5.0,
+                  recover_after_s=5.0, degraded_min_priority=1,
+                  queue_age_s=0.0)
     eng.warm_up()
+    advance, release = _degradation_harness(eng)
     with eng:
         f1 = eng.submit(_feed(), priority=5)
-        # dispatcher holds f1 in its batch window; depth >= 3/4*2 -> 1 is
-        # pressure, degrade_after 0 -> the NEXT admission degrades
         f2 = eng.submit(_feed(), priority=5)
-        deadline = time.monotonic() + 2.0
-        degraded = False
-        while time.monotonic() < deadline and not degraded:
-            degraded = eng.health()["degraded"]
-            if not degraded:
-                time.sleep(0.02)
-        assert degraded, "sustained pressure must enter degraded mode"
+        # two parked requests >= 3/4 of queue_depth: pressure holds, but
+        # the sustain window has not elapsed on the injected clock
+        assert not eng.health()["degraded"]
+        advance(6.0)                           # past degrade_after_s
+        _wait_health(eng, "degraded", True)
         assert eng.health()["current_max_batch"] == 2
         with pytest.raises(serving.Overloaded) as ei:
             eng.submit(_feed(), priority=0)    # below min priority
         assert ei.value.reason == "priority"
         # high-priority traffic still admitted while degraded
         f3 = eng.submit(_feed(), priority=3)
+        release()
         for f in (f1, f2, f3):
             assert f.result(timeout=30)[0].shape == (1, 4)
-        # pressure cleared: recovery restores the full ceiling
-        deadline = time.monotonic() + 5.0
-        while time.monotonic() < deadline and eng.health()["degraded"]:
-            time.sleep(0.05)
-        assert not eng.health()["degraded"]
+        # queue drained -> calm; advancing past recover_after_s restores
+        # the full ceiling at the dispatcher's next idle tick
+        advance(6.0)
+        _wait_health(eng, "degraded", False)
         assert eng.health()["current_max_batch"] == 4
         assert eng.submit(_feed(), priority=0).result(timeout=30)
     acct = eng.accounting()
@@ -338,19 +370,19 @@ def test_degradation_sheds_priority_and_recovers():
 def test_degraded_mode_still_dispatches_oversized_requests():
     """A request wider than the degraded batch ceiling (but within
     max_batch) must dispatch alone, never strand without an outcome."""
-    eng = _engine(max_batch=4, queue_depth=2, batch_window_s=0.4,
-                  degrade_after_s=0.0, recover_after_s=30.0,
-                  degraded_min_priority=1)
+    eng = _engine(max_batch=4, queue_depth=3, degrade_after_s=5.0,
+                  recover_after_s=30.0, degraded_min_priority=1,
+                  queue_age_s=0.0)
     eng.warm_up()
+    advance, release = _degradation_harness(eng)
     with eng:
         f1 = eng.submit(_feed(), priority=5)
-        f2 = eng.submit(_feed(), priority=5)   # pressure -> degraded
-        deadline = time.monotonic() + 5.0
-        while time.monotonic() < deadline and not eng.health()["degraded"]:
-            time.sleep(0.02)
-        assert eng.health()["degraded"]
+        f2 = eng.submit(_feed(), priority=5)
+        advance(6.0)                           # sustain -> degraded
+        _wait_health(eng, "degraded", True)
         assert eng.health()["current_max_batch"] == 2
         f3 = eng.submit(_feed(rows=3), priority=5)   # 3 > degraded cap 2
+        release()
         assert f3.result(timeout=30)[0].shape == (3, 4)
         for f in (f1, f2):
             f.result(timeout=30)

@@ -115,16 +115,18 @@ def _bert_engine(ci: bool, config: serving.ServingConfig):
 
 
 def _gpt_engine(ci: bool, config: serving.ServingConfig,
-                gen_config=None):
+                gen_config=None, **net_kw):
     """GPT-tiny generative engine (prefill/decode split scheduling over a
-    paged KV cache) — the --decode legs' probe."""
+    paged KV cache) — the --decode legs' probe. ``net_kw`` overrides the
+    model-build knobs (the speculative leg uses a longer KV + k=8)."""
     from paddle_tpu.models.gpt import GptConfig, build_gpt_generative
     import paddle_tpu.unique_name as un
 
+    kw = dict(batch_slots=4, max_seq=32, page_size=8,
+              prompt_buckets=(8, 16))
+    kw.update(net_kw)
     with un.guard():
-        net = build_gpt_generative(
-            GptConfig.tiny(), batch_slots=4, max_seq=32, page_size=8,
-            prompt_buckets=(8, 16))
+        net = build_gpt_generative(GptConfig.tiny(), **kw)
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
@@ -391,8 +393,10 @@ def leg_decode(name, ci):
             seen["tokens_streamed"] == seen["tokens_expected"],
         "no_untyped_errors": seen["other_error"] == 0,
         "zero_warm_recompiles": stats["decode_recompiles"] == 0,
+        # prefill:8 + prefill:16 + decode:4 + chunk:8 (the chunked-
+        # prefill program is default-on since ISSUE 20)
         "one_executable_per_phase_bucket":
-            len(stats["compiled_buckets"]) == 3,
+            len(stats["compiled_buckets"]) == 4,
         "intertoken_histogram_present":
             metrics.get("intertoken_count", 0) > 0,
     }
@@ -434,6 +438,161 @@ def leg_decode_chaos(name, ci):
             "checks": checks, "decode": _decode_metrics(t_wall),
             "why": "one in-flight batch killed: affected streams settle "
                    "typed BatchFailed, engine keeps serving"}
+
+
+def _first_token_snap():
+    s = monitor.metric_value("serving_first_token_seconds", default=None)
+    return (s["count"], s["sum"]) if isinstance(s, dict) else (0, 0.0)
+
+
+def leg_decode_prefix(name, ci, enabled=True):
+    """Shared-prefix burst (ISSUE 20): a cold group of distinct long
+    prompts, then a warm group repeating one 24-token prefix. Warm
+    requests must HIT the prefix cache (skipping prefill for the shared
+    pages — one suffix chunk slice instead of four cold slices) and
+    show a lower average first-token latency than the cold group.
+    ``enabled=False`` is the --negative-control variant: with the cache
+    off the hit counters MUST stay zero, so the gate fails."""
+    cfg = serving.ServingConfig(max_batch=4, queue_depth=64, deadline_s=0)
+    gen = serving.GenerationConfig(decode_chunk=2, prefix_cache=enabled,
+                                   chunked_prefill=True)
+    eng = _gpt_engine(ci, cfg, gen_config=gen)
+    eng.warm_up()
+    rng = np.random.RandomState(20)
+    shared = rng.randint(1, 128, 24)       # 3 whole 8-row pages
+    n = 4 if ci else 12
+    with eng:
+        c0, s0 = _first_token_snap()
+        for _ in range(n):                 # cold: distinct prefixes
+            p = np.concatenate([rng.randint(1, 128, 24),
+                                rng.randint(1, 128, 6)])
+            eng.submit(p, max_new_tokens=2).result(timeout=600)
+        c1, s1 = _first_token_snap()
+        # seed publishes the shared pages, then the warm group hits them
+        eng.submit(np.concatenate([shared, rng.randint(1, 128, 6)]),
+                   max_new_tokens=2).result(timeout=600)
+        c2, s2 = _first_token_snap()
+        for _ in range(n):
+            p = np.concatenate([shared, rng.randint(1, 128, 6)])
+            eng.submit(p, max_new_tokens=2).result(timeout=600)
+        c3, s3 = _first_token_snap()
+    acct = eng.accounting()
+    stats = eng.generation_stats()
+    pc = stats["prefix_cache"] or {"hits": 0, "misses": max(1, 2 * n + 1),
+                                   "pages_reused": 0, "pages": 0}
+    hit_ratio = pc["hits"] / max(1, pc["hits"] + pc["misses"])
+    cold_ms = (s1 - s0) / max(1, c1 - c0) * 1e3
+    warm_ms = (s3 - s2) / max(1, c3 - c2) * 1e3
+    ft = monitor.metric_value("serving_first_token_seconds", default=None)
+    report = {
+        "prefix_hit_ratio": hit_ratio,
+        "prefix_hits": pc["hits"], "prefix_misses": pc["misses"],
+        "pages_reused": pc["pages_reused"], "pages_resident": pc["pages"],
+        "first_token_p50_ms":
+            (ft["p50"] or 0.0) * 1e3 if isinstance(ft, dict) else None,
+        "first_token_p99_ms":
+            (ft["p99"] or 0.0) * 1e3 if isinstance(ft, dict) else None,
+        "cold_first_token_avg_ms": cold_ms,
+        "warm_first_token_avg_ms": warm_ms,
+        "warm_speedup": (cold_ms / warm_ms) if warm_ms > 0 else None,
+    }
+    checks = {
+        "exact_accounting": bool(acct["exact"]),
+        "prefix_hits_positive": pc["hits"] >= n,
+        "shared_pages_reused": pc["pages_reused"] >= 3 * n,
+        "first_token_p99_reported":
+            report["first_token_p99_ms"] is not None,
+        "warm_first_token_faster_than_cold": warm_ms < cold_ms,
+        "zero_warm_recompiles": stats["decode_recompiles"] == 0,
+    }
+    return {"name": name, "ok": all(checks.values()), "requests": 2 * n + 1,
+            "caller_view": {"submitted": 2 * n + 1,
+                            "completed": acct["completed"]},
+            "engine_accounting": acct, "checks": checks,
+            "generation": stats, "prefix": report,
+            "why": "repeated 24-token prefix provably skips prefill for "
+                   "the shared pages: hit counters + first-token delta"}
+
+
+def leg_decode_spec(name, ci, enabled=True):
+    """Speculative-decoding leg (ISSUE 20): the same greedy prompt set
+    through a plain engine and a speculative engine. Gates: bit-exact
+    streams, >= 1.5x tokens/s, acceptance histogram present.
+    ``enabled=False`` is the --negative-control variant: with
+    speculation off no acceptance histogram may exist, so the gate
+    fails."""
+    n = 6 if ci else 12
+    max_new = 56
+
+    def run(speculative):
+        cfg = serving.ServingConfig(max_batch=4, queue_depth=64,
+                                    deadline_s=0)
+        gen = serving.GenerationConfig(
+            decode_chunk=2, prefix_cache=False, chunked_prefill=False,
+            speculative=speculative)
+        # longer KV + k=8 (the full sublane tile): a fully accepted
+        # verify chunk commits 8 tokens in ONE dispatch vs 2 for a plain
+        # decode chunk, and 56-token streams amortize prefill overhead
+        eng = _gpt_engine(ci, cfg, gen_config=gen, max_seq=128,
+                          prompt_buckets=(8,), spec_k=8)
+        eng.warm_up()
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(1, 128, 4 + i % 5) for i in range(n)]
+        best_tps, outs = 0.0, []
+        with eng:
+            # one stream at a time: decode is latency-bound, the win is
+            # tokens-per-dispatch (verify commits up to k+1 per chunk).
+            # Best-of-two passes: greedy streams are deterministic, so
+            # the repeat only de-noises the wall clock
+            for _ in range(2):
+                outs, t0 = [], time.time()
+                for p in prompts:
+                    outs.append(list(
+                        eng.submit(p, max_new_tokens=max_new)
+                        .result(timeout=600)[0]))
+                wall = time.time() - t0
+                toks = sum(len(o) for o in outs)
+                best_tps = max(best_tps,
+                               toks / wall if wall > 0 else 0.0)
+        return eng, outs, best_tps
+
+    plain_eng, plain_out, plain_tps = run(False)
+    spec_eng, spec_out, spec_tps = run(enabled)
+    acct = spec_eng.accounting()
+    stats = spec_eng.generation_stats()
+    accepted = monitor.metric_value("serving_spec_accepted_len",
+                                    default=None)
+    speedup = (spec_tps / plain_tps) if plain_tps > 0 else 0.0
+    report = {
+        "bit_exact": spec_out == plain_out,
+        "tokens_per_s_plain": plain_tps,
+        "tokens_per_s_spec": spec_tps,
+        "speedup": speedup,
+        "verify_chunks": stats["speculative"]["chunks"],
+        "accepted_tokens": stats["speculative"]["accepted_tokens"],
+        "accepted_len_avg":
+            accepted["avg"] if isinstance(accepted, dict) else None,
+        "accepted_len_p50":
+            accepted["p50"] if isinstance(accepted, dict) else None,
+    }
+    checks = {
+        "exact_accounting":
+            bool(acct["exact"] and plain_eng.accounting()["exact"]),
+        "greedy_bit_exact": report["bit_exact"],
+        "speedup_at_least_1_5x": speedup >= 1.5,
+        "acceptance_histogram_present": isinstance(accepted, dict)
+            and accepted["count"] > 0,
+        "zero_warm_recompiles": stats["decode_recompiles"] == 0
+            and plain_eng.generation_stats()["decode_recompiles"] == 0,
+    }
+    return {"name": name, "ok": all(checks.values()), "requests": 4 * n,
+            "caller_view": {"submitted": 4 * n,
+                            "completed": acct["completed"]
+                            + plain_eng.accounting()["completed"]},
+            "engine_accounting": acct, "checks": checks,
+            "generation": stats, "spec": report,
+            "why": "greedy speculative decode bit-exact vs plain with "
+                   ">=1.5x tokens/s (accept-verify in one dispatch)"}
 
 
 # ---------------------------------------------------------------------------
@@ -2155,6 +2314,13 @@ def main(argv=None) -> int:
         # overload_was_shed requirement must trip the gate
         legs.append(leg_chaos("chaos_resnet_no_shedding", _resnet_engine,
                               ci, shedding=False))
+        if args.decode:
+            # prefix cache OFF => hit counters must stay zero; spec OFF
+            # => no acceptance histogram — both legs must MISS
+            legs.append(leg_decode_prefix("decode_gpt_prefix_off", ci,
+                                          enabled=False))
+            legs.append(leg_decode_spec("decode_gpt_spec_off", ci,
+                                        enabled=False))
     else:
         legs.append(leg_steady("steady_resnet", _resnet_engine, ci))
         if not args.skip_bert:
@@ -2163,18 +2329,32 @@ def main(argv=None) -> int:
         if args.decode:
             legs.append(leg_decode("decode_gpt", ci))
             legs.append(leg_decode_chaos("decode_gpt_chaos", ci))
+            legs.append(leg_decode_prefix("decode_gpt_prefix", ci))
+            legs.append(leg_decode_spec("decode_gpt_spec", ci))
 
     latency = _latency_snapshot()
     gate_ok = all(l["ok"] for l in legs) and latency is not None \
         and latency["count"] > 0 and latency["p50"] is not None \
         and latency["p99"] is not None
-    decode_report = None
+    decode_report = prefix_report = spec_report = None
     if args.decode and not args.negative_control:
         decode_report = next((l["decode"] for l in legs
                               if l["name"] == "decode_gpt"), None)
+        prefix_report = next((l.get("prefix") for l in legs
+                              if l["name"] == "decode_gpt_prefix"), None)
+        spec_report = next((l.get("spec") for l in legs
+                            if l["name"] == "decode_gpt_spec"), None)
         gate_ok = gate_ok and decode_report is not None \
             and (decode_report.get("tokens_per_s") or 0) > 0 \
             and decode_report.get("intertoken_p99_ms") is not None
+        # ISSUE 20 acceptance: prefix-hit-ratio + first-token p99 in the
+        # artifact, bit-exact speculative decode at >= 1.5x tokens/s
+        gate_ok = gate_ok and prefix_report is not None \
+            and prefix_report["prefix_hit_ratio"] > 0 \
+            and prefix_report["first_token_p99_ms"] is not None
+        gate_ok = gate_ok and spec_report is not None \
+            and spec_report["bit_exact"] \
+            and spec_report["speedup"] >= 1.5
 
     for l in legs:
         status = "ok" if l["ok"] else "MISS"
@@ -2194,6 +2374,19 @@ def main(argv=None) -> int:
               f"tokens/s={decode_report['tokens_per_s']:.1f} "
               f"intertoken p50={decode_report['intertoken_p50_ms']:.2f}ms "
               f"p99={decode_report['intertoken_p99_ms']:.2f}ms")
+    if prefix_report:
+        print(f"prefix: hit_ratio={prefix_report['prefix_hit_ratio']:.2f} "
+              f"pages_reused={prefix_report['pages_reused']} "
+              f"first-token cold="
+              f"{prefix_report['cold_first_token_avg_ms']:.2f}ms warm="
+              f"{prefix_report['warm_first_token_avg_ms']:.2f}ms "
+              f"p99={prefix_report['first_token_p99_ms']:.2f}ms")
+    if spec_report:
+        print(f"speculative: bit_exact={spec_report['bit_exact']} "
+              f"tokens/s {spec_report['tokens_per_s_plain']:.1f} -> "
+              f"{spec_report['tokens_per_s_spec']:.1f} "
+              f"({spec_report['speedup']:.2f}x), accepted/chunk avg="
+              f"{spec_report['accepted_len_avg'] or 0:.2f}")
     print(f"serving gate ({time.time() - t0:.1f}s) -> "
           f"{'ok' if gate_ok else 'FAIL'}")
 
@@ -2203,6 +2396,8 @@ def main(argv=None) -> int:
                 "legs": legs,
                 "latency_histogram": latency,
                 "decode": decode_report,
+                "decode_prefix": prefix_report,
+                "decode_spec": spec_report,
                 "snapshot": monitor.snapshot(),
                 "check": {"status": "ok" if gate_ok else "fail",
                           "negative_control": bool(args.negative_control)},
